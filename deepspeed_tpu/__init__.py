@@ -1,0 +1,44 @@
+"""deepspeed_tpu: a TPU-native distributed training framework.
+
+A ground-up JAX/XLA/Pallas framework with the capabilities of DeepSpeed
+(reference surveyed in SURVEY.md): config-driven training engine, ZeRO-style
+sharding over named meshes, offload tiers, TP/PP/EP/SP parallelism, fused Pallas
+kernels, comms logging, universal checkpointing, launcher, profilers, and an
+inference path.
+
+Top-level API parity (reference ``deepspeed/__init__.py``):
+  initialize()       -> (engine, optimizer, dataloader, lr_scheduler)
+  init_distributed() -> mesh topology rendezvous
+  init_inference()   -> inference engine
+"""
+
+__version__ = "0.1.0"
+
+from deepspeed_tpu.comm.comm import init_distributed  # noqa: F401
+from deepspeed_tpu.config.config import Config, load_config  # noqa: F401
+from deepspeed_tpu.accelerator.real_accelerator import get_accelerator  # noqa: F401
+
+
+def initialize(*args, **kwargs):
+    """Build the training engine (reference ``deepspeed/__init__.py:93``).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    Thin lazy wrapper so importing the package stays cheap.
+    """
+    try:
+        from deepspeed_tpu.runtime.engine import initialize as _initialize
+    except ImportError as e:
+        raise NotImplementedError(
+            "deepspeed_tpu.runtime.engine is not available in this build yet"
+        ) from e
+    return _initialize(*args, **kwargs)
+
+
+def init_inference(*args, **kwargs):
+    try:
+        from deepspeed_tpu.inference.engine import init_inference as _init_inference
+    except ImportError as e:
+        raise NotImplementedError(
+            "deepspeed_tpu.inference.engine is not available in this build yet"
+        ) from e
+    return _init_inference(*args, **kwargs)

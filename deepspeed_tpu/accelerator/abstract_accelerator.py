@@ -1,0 +1,99 @@
+"""Hardware-abstraction interface.
+
+Role parity with the reference's ``accelerator/abstract_accelerator.py:10``
+(``DeepSpeedAccelerator`` ABC, ~70 methods). In a JAX world most of the CUDA
+surface (streams, events, graph capture) is owned by the XLA runtime, so the
+interface shrinks to what callers genuinely vary on: device discovery, dtype
+capability probes, memory introspection, RNG, synchronization, profiler ranges,
+and the communication-backend name. Kernel lookup (the reference's
+``op_builder`` factory, ``abstract_accelerator.py:268-303``) maps to the Pallas
+kernel registry in :mod:`deepspeed_tpu.ops`.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+
+class Accelerator(abc.ABC):
+    _name: str = "abstract"
+
+    # ------------------------------------------------------------ identity
+    def device_name(self) -> str:
+        return self._name
+
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str: ...
+
+    # ------------------------------------------------------------ devices
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        """Addressable (process-local) device count."""
+
+    @abc.abstractmethod
+    def global_device_count(self) -> int: ...
+
+    @abc.abstractmethod
+    def devices(self) -> list: ...
+
+    def current_device(self):
+        return self.devices()[0]
+
+    # ------------------------------------------------------------ capabilities
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool: ...
+
+    def is_fp8_supported(self) -> bool:
+        return False
+
+    @abc.abstractmethod
+    def supports_pallas(self) -> bool:
+        """Can compiled Pallas TPU kernels run natively (vs interpret mode)?"""
+
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.is_bf16_supported() else jnp.float32
+
+    # ------------------------------------------------------------ memory
+    @abc.abstractmethod
+    def memory_stats(self, device=None) -> dict[str, int]:
+        """Returns at least {'bytes_in_use': int, 'bytes_limit': int} when known."""
+
+    def available_memory(self, device=None) -> int:
+        stats = self.memory_stats(device)
+        return max(stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0), 0)
+
+    # ------------------------------------------------------------ execution
+    def synchronize(self) -> None:
+        import jax
+
+        jax.block_until_ready(jax.device_put(0))
+
+    def default_mesh_axis_order(self) -> list[str]:
+        """Preferred physical ordering of logical axes (innermost = fastest links)."""
+        return ["pipeline", "data", "fsdp", "expert", "sequence", "tensor"]
+
+    # ------------------------------------------------------------ RNG
+    def default_rng_impl(self) -> str | None:
+        return None
+
+    # ------------------------------------------------------------ profiling
+    def range_push(self, name: str) -> Any:
+        import jax.profiler
+
+        tc = jax.profiler.TraceAnnotation(name)
+        tc.__enter__()
+        return tc
+
+    def range_pop(self, ctx: Any) -> None:
+        ctx.__exit__(None, None, None)
+
+    # ------------------------------------------------------------ host memory
+    def pinned_memory_sharding(self):
+        """Sharding placing arrays in pinned host memory, or None if unsupported."""
+        return None

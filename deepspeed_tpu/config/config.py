@@ -1,0 +1,391 @@
+"""The framework config tree.
+
+Role parity with the reference's ``runtime/config.py`` (``DeepSpeedConfig``) and its
+per-feature sub-configs (``runtime/zero/config.py``, ``precision_config.py``,
+``zenflow_config.py``, monitor/comms/flops configs). Same shape: one JSON/dict in,
+a validated typed tree out, with the batch-size triangle
+(``train_batch_size = micro_batch_size * gradient_accumulation_steps * dp_world``)
+resolved centrally.
+
+TPU-first differences: a ``mesh`` section declares named parallelism axes
+(data/fsdp/tensor/sequence/expert/pipeline) instead of implicit process groups;
+precision is bf16-default; offload targets are host DRAM / NVMe on the TPU-VM.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, ClassVar, Optional, Union
+
+from deepspeed_tpu.config.base import AUTO, ConfigBase, ConfigError, is_auto
+
+
+@dataclass
+class OptimizerConfig(ConfigBase):
+    type: str = "adamw"  # adamw | adam | sgd | lion | lamb | adagrad
+    params: dict = field(default_factory=dict)
+
+    _SUPPORTED: ClassVar[set] = {"adam", "adamw", "sgd", "lion", "lamb", "adagrad", "muon"}
+
+    def _validate(self, path: str = "") -> None:
+        if self.type.lower() not in self._SUPPORTED:
+            raise ConfigError(f"{path}type: unsupported optimizer '{self.type}' (choose from {sorted(self._SUPPORTED)})")
+
+
+@dataclass
+class SchedulerConfig(ConfigBase):
+    """Reference LR schedules: WarmupLR / WarmupDecayLR / WarmupCosineLR / OneCycle / LRRangeTest
+    (``runtime/lr_schedules.py``)."""
+
+    type: str = "WarmupLR"
+    params: dict = field(default_factory=dict)
+
+
+@dataclass
+class FP16Config(ConfigBase):
+    """fp16 + dynamic loss scaling (reference: ``runtime/fp16/loss_scaler.py:187``)."""
+
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+    _auto_fields: ClassVar[set] = {"enabled"}
+
+
+@dataclass
+class BF16Config(ConfigBase):
+    # None = "auto": on unless fp16 is explicitly enabled (TPU-first default).
+    enabled: Optional[bool] = None
+    # Keep a float32 master copy of params and do the optimizer step in fp32
+    # (reference: runtime/bf16_optimizer.py:37).
+    master_weights: bool = True
+
+    _auto_fields: ClassVar[set] = {"enabled"}
+
+
+@dataclass
+class OffloadConfig(ConfigBase):
+    """Offload tier for optimizer state / params (reference: zero offload + swap_tensor)."""
+
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: str = "/tmp/dstpu_nvme"
+    pin_memory: bool = True
+    buffer_count: int = 4
+    # ZenFlow-style split: top-k important gradient columns stay on device.
+    zenflow_topk_ratio: float = 0.0
+
+    def _validate(self, path: str = "") -> None:
+        if self.device not in ("none", "cpu", "nvme"):
+            raise ConfigError(f"{path}device: must be none|cpu|nvme, got {self.device!r}")
+
+
+@dataclass
+class ZeroConfig(ConfigBase):
+    """ZeRO stages as sharding policy (reference: ``runtime/zero/config.py:401``).
+
+    On TPU the stages are declarative sharding choices over the ``fsdp`` mesh axis:
+      0: replicate params/grads/opt-state (pure DP, psum grads)
+      1: shard optimizer state
+      2: shard optimizer state + gradients (reduce_scatter at the GAS boundary)
+      3: shard parameters too (allgather-on-use, per scanned layer block)
+    """
+
+    stage: int = 0
+    offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
+    offload_param: OffloadConfig = field(default_factory=OffloadConfig)
+    # stage-3 style knobs
+    persistence_threshold: int = 0  # params smaller than this stay replicated
+    # ZeRO++ style int8-quantized collectives
+    quantized_weights: bool = False
+    quantized_gradients: bool = False
+    # MiCS/hpZ: secondary replication group size (0 = off)
+    zero_hpz_partition_size: int = 0
+
+    def _validate(self, path: str = "") -> None:
+        if self.stage not in (0, 1, 2, 3):
+            raise ConfigError(f"{path}stage: must be 0..3, got {self.stage}")
+
+    @classmethod
+    def from_dict(cls, data, path: str = ""):
+        data = dict(data or {})
+        # Legacy `cpu_offload` was a bool; translate to an offload tier, not a rename.
+        if "cpu_offload" in data:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                f"Config field '{path}cpu_offload' is deprecated; use "
+                f"'{path}offload_optimizer: {{device: cpu}}'."
+            )
+            legacy = data.pop("cpu_offload")
+            if "offload_optimizer" not in data:
+                if isinstance(legacy, bool):
+                    data["offload_optimizer"] = {"device": "cpu" if legacy else "none"}
+                else:
+                    data["offload_optimizer"] = legacy
+        return super().from_dict(data, path=path)
+
+
+@dataclass
+class MeshConfig(ConfigBase):
+    """Named device-mesh axes. 'auto' (-1) sizes one axis from the device count.
+
+    Axis vocabulary (fixed): data, fsdp, tensor, sequence, expert, pipeline.
+    The DP world used in the batch triangle is data*fsdp (both consume batch).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    expert: int = 1
+    pipeline: int = 1
+    # axes listed here are laid out over DCN (multi-slice) rather than ICI
+    dcn_axes: list = field(default_factory=list)
+
+    def _validate(self, path: str = "") -> None:
+        for name in ("fsdp", "tensor", "sequence", "expert", "pipeline"):
+            if getattr(self, name) < 1:
+                raise ConfigError(f"{path}{name}: must be >= 1")
+        if self.data < -1 or self.data == 0:
+            raise ConfigError(f"{path}data: must be -1 (auto) or >= 1")
+
+
+@dataclass
+class ActivationCheckpointingConfig(ConfigBase):
+    """Rematerialization policy (reference: ``runtime/activation_checkpointing/``).
+
+    On TPU this maps to ``jax.checkpoint`` policies on the scanned layer stack.
+    """
+
+    enabled: bool = False
+    policy: str = "full"  # full | dots_saveable | nothing_saveable | offload_dots
+
+    def _validate(self, path: str = "") -> None:
+        if self.policy not in ("full", "dots_saveable", "nothing_saveable", "offload_dots"):
+            raise ConfigError(f"{path}policy: unknown remat policy {self.policy!r}")
+
+
+@dataclass
+class MoEConfig(ConfigBase):
+    enabled: bool = False
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    aux_loss_coef: float = 0.01
+    router_jitter: float = 0.0
+
+
+@dataclass
+class SequenceParallelConfig(ConfigBase):
+    """Ulysses / ring attention (reference: ``deepspeed/sequence/``)."""
+
+    mode: str = "ulysses"  # ulysses | ring
+    tiled_mlp: bool = False
+    tiled_logits: bool = False
+
+    def _validate(self, path: str = "") -> None:
+        if self.mode not in ("ulysses", "ring"):
+            raise ConfigError(f"{path}mode: must be ulysses|ring")
+
+
+@dataclass
+class PipelineConfig(ConfigBase):
+    """Pipeline schedule config (reference: ``runtime/pipe/``)."""
+
+    num_microbatches: int = 0  # 0 => use gradient_accumulation_steps
+    partition_method: str = "uniform"  # uniform | parameters
+    activation_checkpoint_interval: int = 0
+
+
+@dataclass
+class TensorParallelConfig(ConfigBase):
+    """AutoTP equivalent (reference: ``module_inject/auto_tp.py``): declarative
+    sharding-rule overrides applied to model params/activations."""
+
+    enabled: bool = False
+    rules: dict = field(default_factory=dict)  # param-name regex -> axis name
+
+
+@dataclass
+class MonitorConfig(ConfigBase):
+    enabled: bool = False
+    tensorboard: dict = field(default_factory=dict)  # {enabled, output_path, job_name}
+    csv_monitor: dict = field(default_factory=dict)
+    wandb: dict = field(default_factory=dict)
+
+
+@dataclass
+class CommsLoggerConfig(ConfigBase):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    prof_ops: list = field(default_factory=list)
+    debug: bool = False
+
+
+@dataclass
+class FlopsProfilerConfig(ConfigBase):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclass
+class CheckpointConfig(ConfigBase):
+    use_node_local_storage: bool = False
+    tag_validation: str = "warn"  # ignore | warn | fail
+    load_universal: bool = False
+    async_save: bool = False
+    keep_n_latest: int = 0  # 0 = keep all
+
+    def _validate(self, path: str = "") -> None:
+        if self.tag_validation.lower() not in ("ignore", "warn", "fail"):
+            raise ConfigError(f"{path}tag_validation: must be ignore|warn|fail")
+
+
+@dataclass
+class DataEfficiencyConfig(ConfigBase):
+    enabled: bool = False
+    curriculum_learning: dict = field(default_factory=dict)
+
+
+@dataclass
+class Config(ConfigBase):
+    """Top-level framework config (reference: ``DeepSpeedConfig``)."""
+
+    train_batch_size: Union[int, str, None] = None
+    train_micro_batch_size_per_device: Union[int, str, None] = None
+    gradient_accumulation_steps: Union[int, str, None] = None
+    steps_per_print: int = 10
+    gradient_clipping: float = 0.0
+    seed: int = 1234
+    communication_data_type: Optional[str] = None  # e.g. "fp32" grad-reduce dtype
+    prescale_gradients: bool = False
+    sequence_length: Union[int, None] = None  # used by SP sharding + MFU accounting
+
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    scheduler: Optional[SchedulerConfig] = None
+    fp16: FP16Config = field(default_factory=FP16Config)
+    bf16: BF16Config = field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = field(
+        default_factory=ActivationCheckpointingConfig
+    )
+    moe: MoEConfig = field(default_factory=MoEConfig)
+    sequence_parallel: SequenceParallelConfig = field(default_factory=SequenceParallelConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    monitor: MonitorConfig = field(default_factory=MonitorConfig)
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
+
+    _auto_fields: ClassVar[set] = {
+        "train_batch_size",
+        "train_micro_batch_size_per_device",
+        "gradient_accumulation_steps",
+    }
+    _deprecated: ClassVar[dict] = {
+        "train_micro_batch_size_per_gpu": "train_micro_batch_size_per_device",
+        "zero": "zero_optimization",
+    }
+
+    # ------------------------------------------------------------------ batch triangle
+    def resolve_batch_sizes(self, dp_world_size: int) -> None:
+        """Resolve train_batch = micro_batch * GAS * dp_world (reference: runtime/config.py).
+
+        Any one of the three may be omitted/'auto'; the others determine it.
+        """
+        tb = None if is_auto(self.train_batch_size) else self.train_batch_size
+        mb = None if is_auto(self.train_micro_batch_size_per_device) else self.train_micro_batch_size_per_device
+        gas = None if is_auto(self.gradient_accumulation_steps) else self.gradient_accumulation_steps
+
+        if tb is not None and mb is not None and gas is None:
+            gas, rem = divmod(tb, mb * dp_world_size)
+            if rem:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by micro_batch {mb} * dp_world {dp_world_size}"
+                )
+        elif tb is not None and gas is not None and mb is None:
+            mb, rem = divmod(tb, gas * dp_world_size)
+            if rem:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by GAS {gas} * dp_world {dp_world_size}"
+                )
+        elif mb is not None and tb is None:
+            gas = gas if gas is not None else 1
+            tb = mb * gas * dp_world_size
+        elif tb is not None and mb is None and gas is None:
+            gas = 1
+            mb, rem = divmod(tb, dp_world_size)
+            if rem:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by dp_world {dp_world_size}"
+                )
+        elif tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp_world_size:
+                raise ConfigError(
+                    f"Inconsistent batch triangle: train_batch_size {tb} != "
+                    f"micro {mb} * GAS {gas} * dp_world {dp_world_size}"
+                )
+        elif tb is None and mb is None:
+            raise ConfigError(
+                "Provide at least train_micro_batch_size_per_device or train_batch_size"
+            )
+        if gas is None:
+            gas = 1
+        if mb is None:
+            raise ConfigError("Could not resolve micro batch size")
+        self.train_batch_size = int(tb)
+        self.train_micro_batch_size_per_device = int(mb)
+        self.gradient_accumulation_steps = int(gas)
+
+    def _validate(self, path: str = "") -> None:
+        # reference: engine.py:1386 _assert_valid_mixed_precision_config.
+        # bf16 defaults to auto (None): on unless fp16 was explicitly enabled.
+        if self.fp16.enabled is True and self.bf16.enabled is True:
+            raise ConfigError("fp16 and bf16 cannot both be enabled")
+        if self.bf16.enabled is None:
+            self.bf16.enabled = not (self.fp16.enabled is True)
+
+    @property
+    def precision_name(self) -> str:
+        if self.fp16.enabled is True:
+            return "fp16"
+        if self.bf16.enabled is True:
+            return "bf16"
+        return "fp32"
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.fp16.enabled is True:
+            return jnp.float16
+        if self.bf16.enabled in (True, None):
+            return jnp.bfloat16
+        return jnp.float32
+
+
+def load_config(config: Union[str, dict, Config, None]) -> Config:
+    """Accept a path to JSON, a dict, or an already-built Config."""
+    if config is None:
+        return Config()
+    if isinstance(config, Config):
+        return config
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    return Config.from_dict(config)

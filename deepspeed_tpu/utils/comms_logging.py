@@ -1,0 +1,162 @@
+"""Per-collective communication logging.
+
+Role parity with ``deepspeed/utils/comms_logging.py`` (``CommsLogger``,
+``calc_bw_log:34``) and the ``timed_op`` wrapper (``comm/comm.py:106``): every
+collective issued through :mod:`deepspeed_tpu.comm.comm` records op name, bytes
+moved, call count, and — where measurable — latency and algorithmic/bus bandwidth.
+
+TPU adaptation: collectives inside a jitted step are compiled into the XLA
+program, so per-call host timing is meaningless there. We therefore keep two
+ledgers: (1) a *trace-time* ledger of collectives captured while staging the step
+(op, tensor bytes, axis, estimated bytes-on-wire) — the static "comms plan"; and
+(2) an *eager* ledger with real wall-clock latency for host-level collectives
+(barriers, broadcasts, checkpoint-tag validation). ``log_summary`` renders both,
+with min/max across processes for straggler detection when distributed.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def get_caller_func(depth: int = 2) -> str:
+    import sys
+
+    try:
+        return sys._getframe(depth).f_code.co_name
+    except ValueError:  # stack shallower than requested (REPL/top-level)
+        return "<toplevel>"
+
+
+def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n_ranks: int):
+    """Algorithmic and bus bandwidth in GB/s (reference: comms_logging.py:34)."""
+    duration_s = max(duration_s, 1e-12)
+    if comm_op in ("all_to_all",):
+        # each rank sends size*(n-1)/n
+        tput = size_bytes / duration_s
+        busbw = tput * ((n_ranks - 1) / max(n_ranks, 1))
+    elif comm_op in ("all_gather", "reduce_scatter"):
+        size_bytes *= n_ranks
+        tput = size_bytes / duration_s
+        busbw = (size_bytes / duration_s) * ((n_ranks - 1) / max(n_ranks, 1))
+    elif comm_op in ("all_reduce", "psum"):
+        tput = size_bytes * 2 / duration_s
+        busbw = (size_bytes / duration_s) * (2 * (n_ranks - 1) / max(n_ranks, 1))
+    else:  # send/recv/broadcast/ppermute
+        tput = size_bytes / duration_s
+        busbw = tput
+    return tput / 1e9, busbw / 1e9
+
+
+@dataclass
+class _OpRecord:
+    count: int = 0
+    total_bytes: int = 0
+    total_latency: float = 0.0  # seconds; 0 for trace-time records
+    sizes: dict = field(default_factory=lambda: defaultdict(lambda: [0, 0.0]))  # size -> [count, lat]
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False, debug: bool = False,
+                 prof_all: bool = True, prof_ops: list | None = None):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.debug = debug
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.traced: dict[str, _OpRecord] = defaultdict(_OpRecord)
+        self.eager: dict[str, _OpRecord] = defaultdict(_OpRecord)
+
+    def configure(self, cfg) -> None:
+        self.enabled = cfg.enabled
+        self.verbose = cfg.verbose
+        self.debug = cfg.debug
+        self.prof_all = cfg.prof_all
+        self.prof_ops = list(cfg.prof_ops)
+
+    def _should_log(self, op_name: str) -> bool:
+        return self.enabled and (self.prof_all or op_name in self.prof_ops)
+
+    # ------------------------------------------------------- trace-time ledger
+    def append_traced(self, op_name: str, size_bytes: int, axis: str, n_ranks: int,
+                      caller: str = "") -> None:
+        if not self._should_log(op_name):
+            return
+        rec = self.traced[op_name]
+        rec.count += 1
+        rec.total_bytes += size_bytes
+        rec.sizes[size_bytes][0] += 1
+        if self.verbose:
+            log_dist(
+                f"comm trace: {op_name} | axis={axis} ranks={n_ranks} "
+                f"bytes={size_bytes} caller={caller}",
+                ranks=[0],
+            )
+
+    # ------------------------------------------------------- eager ledger
+    def append_eager(self, op_name: str, size_bytes: int, latency_s: float, n_ranks: int) -> None:
+        if not self._should_log(op_name):
+            return
+        rec = self.eager[op_name]
+        rec.count += 1
+        rec.total_bytes += size_bytes
+        rec.total_latency += latency_s
+        s = rec.sizes[size_bytes]
+        s[0] += 1
+        s[1] += latency_s
+        if self.verbose:
+            algbw, busbw = calc_bw_log(op_name, size_bytes, latency_s, n_ranks)
+            log_dist(
+                f"comm: {op_name} | bytes={size_bytes} latency={latency_s * 1e3:.3f}ms "
+                f"algbw={algbw:.2f}GB/s busbw={busbw:.2f}GB/s",
+                ranks=[0],
+            )
+
+    # ------------------------------------------------------- summary
+    def log_summary(self, show_straggler: bool = False) -> str:
+        lines = ["Comms summary (trace-time collectives inside jitted steps):"]
+        for op, rec in sorted(self.traced.items()):
+            lines.append(f"  {op:>18}: calls={rec.count:<6} total={rec.total_bytes / 1e6:.2f} MB")
+        lines.append("Comms summary (eager host-level collectives):")
+        for op, rec in sorted(self.eager.items()):
+            avg_ms = 1e3 * rec.total_latency / max(rec.count, 1)
+            lines.append(
+                f"  {op:>18}: calls={rec.count:<6} total={rec.total_bytes / 1e6:.2f} MB "
+                f"avg={avg_ms:.3f}ms"
+            )
+        if show_straggler:
+            lines += self._straggler_lines()
+        text = "\n".join(lines)
+        logger.info(text)
+        return text
+
+    def _straggler_lines(self) -> list[str]:
+        """Min/max eager latency across processes (reference: log_summary(show_straggler))."""
+        try:
+            import jax
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            if jax.process_count() <= 1:
+                return ["  (single process; no straggler data)"]
+            lines = ["Straggler analysis (min/max across processes):"]
+            for op, rec in sorted(self.eager.items()):
+                mine = np.asarray([rec.total_latency], dtype=np.float32)
+                gathered = multihost_utils.process_allgather(mine)
+                lines.append(
+                    f"  {op:>18}: min={gathered.min() * 1e3:.3f}ms max={gathered.max() * 1e3:.3f}ms"
+                )
+            return lines
+        except Exception as e:  # pragma: no cover
+            return [f"  (straggler gather failed: {e})"]
+
+    def reset(self) -> None:
+        self.traced.clear()
+        self.eager.clear()
+
+
+COMMS_LOGGER = CommsLogger()

@@ -1,0 +1,131 @@
+"""Wall-clock and throughput timers.
+
+Role parity with the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer``, ``ThroughputTimer``). On TPU there are no CUDA
+events; synchronization is ``jax.block_until_ready`` on a token array, and
+device-side timing belongs to ``jax.profiler`` traces. These timers measure the
+host-visible step wall clock, which under JAX async dispatch is the true step
+time as long as each step consumes the previous step's outputs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_TIMERS = ["forward"]
+BACKWARD_TIMERS = ["backward"]
+STEP_TIMERS = ["step"]
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0
+        self._count = 0
+
+    def start(self, sync: bool = False) -> None:
+        if sync:
+            _sync_device()
+        self._start = time.perf_counter()
+        self.started = True
+
+    def stop(self, sync: bool = False) -> None:
+        if not self.started:
+            return
+        if sync:
+            _sync_device()
+        self._elapsed += time.perf_counter() - self._start
+        self._count += 1
+        self.started = False
+
+    def reset(self) -> None:
+        self.started = False
+        self._elapsed = 0.0
+        self._count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        value = self._elapsed
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        return self._elapsed / max(self._count, 1)
+
+
+def _sync_device() -> None:
+    try:
+        from deepspeed_tpu.accelerator.real_accelerator import get_accelerator
+
+        get_accelerator().synchronize()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry; ``log()`` prints elapsed ms per timer."""
+
+    def __init__(self) -> None:
+        self.timers: dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: list[str] | None = None, reset: bool = True, ranks=None) -> None:
+        names = names if names is not None else list(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0
+                parts.append(f"{name}: {elapsed:.2f}ms")
+        if parts:
+            log_dist("time " + " | ".join(parts), ranks=ranks or [0])
+
+
+@dataclass
+class ThroughputTimer:
+    """Samples/sec and TFLOPS per step (reference: ``utils/timer.py:199``)."""
+
+    batch_size: int = 1
+    steps_per_output: int = 100
+    monitor_memory: bool = False
+    logging_fn: object = None
+    total_elapsed: float = field(default=0.0, init=False)
+    step_count: int = field(default=0, init=False)
+    _start: float = field(default=0.0, init=False)
+    flops_per_sample: float = field(default=0.0, init=False)
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+        duration = time.perf_counter() - self._start
+        self.total_elapsed += duration
+        if global_step:
+            self.step_count += 1
+            if report_speed and self.steps_per_output and self.step_count % self.steps_per_output == 0:
+                log_dist(
+                    f"step={self.step_count} samples/sec={self.throughput():.2f} "
+                    f"avg_step_ms={1000 * self.total_elapsed / max(self.step_count, 1):.1f}",
+                    ranks=[0],
+                )
+
+    def throughput(self) -> float:
+        if self.total_elapsed == 0:
+            return 0.0
+        return self.batch_size * self.step_count / self.total_elapsed
+
+    def tflops(self) -> float:
+        if self.total_elapsed == 0 or self.flops_per_sample == 0:
+            return 0.0
+        return self.flops_per_sample * self.throughput() / 1e12

@@ -1,0 +1,47 @@
+"""Test harness: simulate an 8-device mesh on CPU.
+
+Mirrors the reference's distributed-in-one-box strategy
+(``tests/unit/common.py DistributedExec`` spawns N processes + NCCL/gloo): here a
+single process hosts N XLA CPU devices via
+``--xla_force_host_platform_device_count`` and all collectives run for real
+through the CPU backend. Must be set before jax initializes its backend.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["DSTPU_ACCELERATOR"] = "cpu"
+
+# jax may already be preloaded (TPU-tunnel .pth hook) with JAX_PLATFORMS=axon;
+# the backend itself initializes lazily, so redirecting the config here still works.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Each test builds its own topology; reset the module-level singletons."""
+    yield
+    from deepspeed_tpu.comm.topology import reset_topology
+    from deepspeed_tpu.utils.comms_logging import COMMS_LOGGER
+
+    reset_topology()
+    COMMS_LOGGER.reset()
+    COMMS_LOGGER.enabled = False
+
+
+@pytest.fixture
+def mesh8():
+    """A data=8 topology over the simulated devices."""
+    from deepspeed_tpu.comm.comm import init_distributed
+    from deepspeed_tpu.config.config import MeshConfig
+
+    return init_distributed(MeshConfig(data=8))
