@@ -1,0 +1,97 @@
+"""Mixed precision: bf16 policy + fp16 dynamic loss scaling.
+
+Role parity with the reference's ``runtime/bf16_optimizer.py:37`` (bf16 compute
+with fp32 master weights) and ``runtime/fp16/loss_scaler.py:187``
+(``DynamicLossScaler``). TPU-native shape: the scaler is a small pytree of
+device scalars updated *inside* the jitted train step with ``jnp.where`` — no
+host sync to decide whether to skip a step.
+
+Scaler semantics match the reference ``DynamicLossScaler.update_scale``:
+- overflow: consume hysteresis first; once exhausted, scale = max(scale/2, min);
+  remember the overflow step
+- ``scale_window`` consecutive good steps: scale *= 2, hysteresis refilled
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.config.config import FP16Config
+
+
+class LossScaleState(NamedTuple):
+    """Device-resident scaler state (all scalars)."""
+
+    scale: jnp.ndarray          # f32 current loss scale
+    good_steps: jnp.ndarray     # i32 steps since last overflow
+    hysteresis: jnp.ndarray     # i32 remaining overflow tolerance
+    dynamic: jnp.ndarray        # bool: static scale never updates
+
+
+def init_loss_scale(cfg: FP16Config) -> LossScaleState:
+    if not cfg.enabled:
+        return LossScaleState(
+            scale=jnp.float32(1.0),
+            good_steps=jnp.int32(0),
+            hysteresis=jnp.int32(1),
+            dynamic=jnp.asarray(False),
+        )
+    dynamic = cfg.loss_scale == 0.0
+    init = 2.0 ** cfg.initial_scale_power if dynamic else cfg.loss_scale
+    return LossScaleState(
+        scale=jnp.float32(init),
+        good_steps=jnp.int32(0),
+        hysteresis=jnp.int32(cfg.hysteresis),
+        dynamic=jnp.asarray(dynamic),
+    )
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    """True iff every gradient element is finite (reference ``CheckOverflow``)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    finite = jnp.asarray(True)
+    for leaf in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+    return finite
+
+
+def update_loss_scale(
+    state: LossScaleState, finite: jnp.ndarray, cfg: FP16Config
+) -> LossScaleState:
+    """Pure update; mirrors reference ``DynamicLossScaler.update_scale``."""
+    overflow = jnp.logical_not(finite)
+    eat_hysteresis = jnp.logical_and(overflow, state.hysteresis > 1)
+    drop = jnp.logical_and(overflow, jnp.logical_not(eat_hysteresis))
+
+    new_scale = jnp.where(
+        drop, jnp.maximum(state.scale / 2.0, cfg.min_loss_scale), state.scale
+    )
+    new_hyst = jnp.where(eat_hysteresis, state.hysteresis - 1, state.hysteresis)
+    good = jnp.where(overflow, 0, state.good_steps + 1)
+    grow = jnp.logical_and(finite, good >= cfg.loss_scale_window)
+    new_scale = jnp.where(grow, new_scale * 2.0, new_scale)
+    new_hyst = jnp.where(grow, jnp.int32(cfg.hysteresis), new_hyst)
+    good = jnp.where(grow, 0, good)
+
+    # static scale: freeze everything
+    return LossScaleState(
+        scale=jnp.where(state.dynamic, new_scale, state.scale),
+        good_steps=jnp.where(state.dynamic, good, state.good_steps),
+        hysteresis=jnp.where(state.dynamic, new_hyst, state.hysteresis),
+        dynamic=state.dynamic,
+    )
+
+
+def cast_to_compute(tree, compute_dtype):
+    """Cast float params to the compute dtype (master copy stays fp32);
+    the TPU analog of the reference engine's bf16/fp16 module cast."""
+
+    def cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(compute_dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)

@@ -1,0 +1,100 @@
+"""LR-schedule semantics vs hand-computed reference values
+(reference: ``runtime/lr_schedules.py``)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.runtime import lr_schedules as lrs
+
+
+def _lr(schedule, step):
+    return float(schedule(jnp.int32(step)))
+
+
+def test_warmup_log_matches_reference_gamma():
+    s = lrs.warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100, warmup_type="log")
+    for step in [0, 1, 10, 50, 99]:
+        gamma = math.log(step + 1) / math.log(100)
+        assert _lr(s, step) == pytest.approx(0.1 * gamma, rel=1e-5)
+    # past warmup: constant at max
+    assert _lr(s, 100) == pytest.approx(0.1)
+    assert _lr(s, 10_000) == pytest.approx(0.1)
+
+
+def test_warmup_linear():
+    s = lrs.warmup_lr(warmup_min_lr=0.01, warmup_max_lr=0.11, warmup_num_steps=10, warmup_type="linear")
+    assert _lr(s, 0) == pytest.approx(0.01)
+    assert _lr(s, 5) == pytest.approx(0.01 + 0.1 * 0.5)
+    assert _lr(s, 10) == pytest.approx(0.11)
+
+
+def test_warmup_decay_hits_zero_at_total():
+    s = lrs.warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10,
+                            warmup_type="linear")
+    assert _lr(s, 10) == pytest.approx(0.1)
+    # halfway through decay window: (100-55)/(100-10) = 0.5
+    assert _lr(s, 55) == pytest.approx(0.05)
+    assert _lr(s, 100) == pytest.approx(0.0)
+    assert _lr(s, 150) == pytest.approx(0.0)  # clamped, not negative
+
+
+def test_warmup_cosine_parks_at_floor():
+    s = lrs.warmup_cosine_lr(total_num_steps=100, base_lr=1.0, warmup_num_steps=10,
+                             cos_min_ratio=0.1, warmup_type="linear")
+    assert _lr(s, 10) <= 1.0
+    assert _lr(s, 9) == pytest.approx(0.9)  # linear ramp 9/10
+    # far past the end: stays at floor instead of oscillating
+    assert _lr(s, 100) == pytest.approx(0.1, abs=1e-5)
+    assert _lr(s, 500) == pytest.approx(0.1, abs=1e-5)
+
+
+def test_one_cycle_triangle():
+    s = lrs.one_cycle(cycle_min_lr=0.0, cycle_max_lr=1.0, cycle_first_step_size=10,
+                      cycle_second_step_size=10)
+    assert _lr(s, 0) == pytest.approx(0.0, abs=1e-6)
+    assert _lr(s, 5) == pytest.approx(0.5, abs=1e-5)
+    mid = _lr(s, 10)
+    assert mid == pytest.approx(1.0, abs=1e-4)
+    assert _lr(s, 15) == pytest.approx(0.5, abs=1e-4)
+
+
+def test_lr_range_test_continuous_and_staircase():
+    cont = lrs.lr_range_test(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                             lr_range_test_step_rate=1.0)
+    # reference: min_lr * (1 + rate*(it+1)/step_size)
+    assert _lr(cont, 0) == pytest.approx(0.01 * 1.1)
+    assert _lr(cont, 19) == pytest.approx(0.01 * 3.0)
+    stair = lrs.lr_range_test(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                              lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert _lr(stair, 0) == pytest.approx(0.01)
+    assert _lr(stair, 9) == pytest.approx(0.02)
+
+
+def test_schedules_are_jittable():
+    s = lrs.warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10)
+    jitted = jax.jit(s)
+    assert float(jitted(jnp.int32(50))) == pytest.approx(_lr(s, 50))
+
+
+def test_build_schedule_factory():
+    from deepspeed_tpu.config.config import SchedulerConfig
+
+    s = lrs.build_schedule(SchedulerConfig(type="WarmupLR", params={"warmup_max_lr": 0.2}), 0.1)
+    assert _lr(s, 10_000) == pytest.approx(0.2)
+    s = lrs.build_schedule(None, 0.05)
+    assert _lr(s, 123) == pytest.approx(0.05)
+    with pytest.raises(ValueError):
+        lrs.build_schedule(SchedulerConfig(type="Nope"), 0.1)
+
+
+def test_stateful_wrapper_protocol():
+    sched = lrs.LRScheduler(lrs.warmup_lr(warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear"))
+    sched.step()
+    sched.step()
+    assert sched.state_dict() == {"last_batch_iteration": 1}
+    sched2 = lrs.LRScheduler(sched.schedule)
+    sched2.load_state_dict(sched.state_dict())
+    assert sched2.get_last_lr() == sched.get_last_lr()

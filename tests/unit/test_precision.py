@@ -1,0 +1,87 @@
+"""Dynamic loss scaler semantics (reference: ``runtime/fp16/loss_scaler.py:187``)."""
+
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.config.config import FP16Config
+from deepspeed_tpu.runtime import precision
+
+
+def _cfg(**kw):
+    return FP16Config(enabled=True, **kw)
+
+
+def test_initial_scale():
+    st = precision.init_loss_scale(_cfg(initial_scale_power=8))
+    assert float(st.scale) == 256.0
+
+
+def test_static_scale_never_moves():
+    cfg = _cfg(loss_scale=128.0)
+    st = precision.init_loss_scale(cfg)
+    st = precision.update_loss_scale(st, jnp.asarray(False), cfg)
+    assert float(st.scale) == 128.0
+
+
+def test_overflow_halves_after_hysteresis():
+    cfg = _cfg(initial_scale_power=4, hysteresis=2, min_loss_scale=1.0)
+    st = precision.init_loss_scale(cfg)
+    # first overflow eats hysteresis, scale unchanged
+    st = precision.update_loss_scale(st, jnp.asarray(False), cfg)
+    assert float(st.scale) == 16.0
+    assert int(st.hysteresis) == 1
+    # second overflow halves
+    st = precision.update_loss_scale(st, jnp.asarray(False), cfg)
+    assert float(st.scale) == 8.0
+
+
+def test_min_scale_floor():
+    cfg = _cfg(initial_scale_power=1, hysteresis=1, min_loss_scale=1.0)
+    st = precision.init_loss_scale(cfg)
+    for _ in range(5):
+        st = precision.update_loss_scale(st, jnp.asarray(False), cfg)
+    assert float(st.scale) == 1.0
+
+
+def test_growth_after_window():
+    cfg = _cfg(initial_scale_power=4, loss_scale_window=3, hysteresis=2)
+    st = precision.init_loss_scale(cfg)
+    for _ in range(3):
+        st = precision.update_loss_scale(st, jnp.asarray(True), cfg)
+    assert float(st.scale) == 32.0
+    assert int(st.good_steps) == 0
+    assert int(st.hysteresis) == 2  # refilled
+
+
+def test_grads_finite():
+    good = {"a": jnp.ones((3,)), "b": {"c": jnp.zeros((2, 2))}}
+    assert bool(precision.grads_finite(good))
+    bad = {"a": jnp.array([1.0, jnp.nan]), "b": {"c": jnp.zeros((2, 2))}}
+    assert not bool(precision.grads_finite(bad))
+    inf = {"a": jnp.array([1.0, jnp.inf])}
+    assert not bool(precision.grads_finite(inf))
+
+
+def test_cast_to_compute_keeps_ints():
+    tree = {"w": jnp.ones((2,), jnp.float32), "step": jnp.int32(3)}
+    out = precision.cast_to_compute(tree, jnp.bfloat16)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["step"].dtype == jnp.int32
+
+
+def test_optimizer_registry():
+    import optax
+
+    from deepspeed_tpu.config.config import OptimizerConfig
+    from deepspeed_tpu.ops.optimizers import build_optimizer
+
+    for t in ["adamw", "adam", "sgd", "lion", "lamb", "adagrad"]:
+        opt = build_optimizer(OptimizerConfig(type=t, params={"lr": 0.1, "weight_decay": 0.01}))
+        assert isinstance(opt, optax.GradientTransformation)
+        params = {"w": jnp.ones((4, 4))}
+        state = opt.init(params)
+        grads = {"w": jnp.ones((4, 4)) * 0.1}
+        updates, _ = opt.update(grads, state, params)
+        assert updates["w"].shape == (4, 4)
+    with pytest.raises(ValueError):
+        build_optimizer(OptimizerConfig(type="nope"))
