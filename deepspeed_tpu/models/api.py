@@ -1,0 +1,121 @@
+"""Model API: the contract between models and the engine.
+
+The reference wraps mutable ``nn.Module``s (``runtime/engine.py:235``); the
+TPU-native contract is functional: a ``ModelSpec`` bundles pure
+``init/forward/loss`` functions over a parameter pytree, plus *logical axis*
+names per parameter dimension. The sharding planner (``parallel/partition.py``)
+maps logical axes -> mesh axes per ZeRO stage / TP rules — this replaces the
+reference's AutoTP module-graph parsing (``module_inject/auto_tp.py:194``):
+models declare their sharding structure instead of being reverse-engineered.
+
+Logical axis vocabulary (params):
+  "layers"   stacked-layer leading dim (pipeline axis target)
+  "embed"    model hidden dim
+  "heads"    attention head (q) projection dim       -> TP column-parallel
+  "kv_heads" kv projection dim                       -> TP column-parallel
+  "ffn"      MLP intermediate dim                    -> TP column-parallel
+  "vocab"    vocabulary dim                          -> TP row/column
+  "experts"  MoE expert dim                          -> EP
+  None       never sharded
+
+Activations: "batch", "seq", "embed_act", "heads_act", "vocab_act".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Mesh-axis mapping for activation sharding constraints (GSPMD hints).
+DEFAULT_ACTIVATION_RULES = {
+    "batch": ("data", "fsdp"),
+    "seq": "sequence",
+    "embed_act": None,
+    "heads_act": "tensor",
+    "ffn_act": "tensor",
+    "vocab_act": "tensor",
+}
+
+
+@dataclass
+class ShardCtx:
+    """Carries the mesh + activation rules into model code for
+    ``with_sharding_constraint`` hints. A ``None`` mesh disables constraints
+    (single-device or tracing outside the engine)."""
+
+    mesh: Any = None
+    rules: dict = field(default_factory=lambda: dict(DEFAULT_ACTIVATION_RULES))
+
+    def constrain(self, x: jnp.ndarray, *logical_dims: Optional[str]) -> jnp.ndarray:
+        if self.mesh is None:
+            return x
+        spec = []
+        for dim in logical_dims:
+            axis = self.rules.get(dim) if dim is not None else None
+            # drop axes the mesh doesn't parallelize (size 1) to keep specs clean
+            if axis is None:
+                spec.append(None)
+                continue
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            active = tuple(a for a in axes if self.mesh.shape.get(a, 1) > 1)
+            spec.append(active if len(active) > 1 else (active[0] if active else None))
+        pspec = jax.sharding.PartitionSpec(*spec)
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(self.mesh, pspec)
+        )
+
+
+@dataclass
+class ModelSpec:
+    """Everything the engine needs to train/evaluate a model."""
+
+    name: str
+    config: Any
+    # init_fn(rng) -> params pytree (fp32 master weights)
+    init_fn: Callable
+    # loss_fn(params, batch, rng) -> scalar loss (batch: dict of arrays)
+    loss_fn: Callable
+    # forward_fn(params, input_ids) -> logits
+    forward_fn: Callable
+    # pytree congruent to params: tuple of logical axis names per dim
+    param_logical_axes: Any = None
+    # analytics for MFU / flops profiler
+    num_params: int = 0
+    flops_per_token: Callable[[int], float] | None = None
+
+
+def causal_lm_loss(
+    logits: jnp.ndarray,
+    input_ids: jnp.ndarray,
+    labels: jnp.ndarray | None = None,
+    ignore_index: int = -100,
+    z_loss: float = 0.0,
+) -> jnp.ndarray:
+    """Next-token cross entropy in fp32.
+
+    With ``labels=None``, targets are ``input_ids`` shifted left (predict t+1
+    from position t). Provided ``labels`` must already be aligned with logits.
+    Positions equal to ``ignore_index`` are masked out.
+    """
+    if labels is None:
+        logits = logits[:, :-1]
+        targets = input_ids[:, 1:]
+    else:
+        targets = labels
+    logits = logits.astype(jnp.float32)
+    mask = (targets != ignore_index).astype(jnp.float32)
+    safe_targets = jnp.where(targets == ignore_index, 0, targets)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, safe_targets[..., None], axis=-1)[..., 0]
+    nll = (logz - true_logit) * mask
+    loss = nll.sum() / jnp.maximum(mask.sum(), 1.0)
+    if z_loss > 0.0:
+        loss = loss + z_loss * ((logz * mask) ** 2).sum() / jnp.maximum(mask.sum(), 1.0)
+    return loss
+
+
+def count_params(params) -> int:
+    return int(sum(x.size for x in jax.tree_util.tree_leaves(params)))

@@ -1,0 +1,162 @@
+"""GPT-2 causal LM — the correctness-baseline model (BASELINE.json: GPT-2 125M
+ZeRO-1 single-host config).
+
+LayerNorm(+bias), learned positional embeddings, GELU MLP, tied LM head —
+matching HF ``GPT2LMHeadModel`` semantics. Same functional stacked-scan design
+as ``models/llama.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.models.api import ModelSpec, ShardCtx, causal_lm_loss
+from deepspeed_tpu.ops.attention import attention
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def ffn(self) -> int:
+        return 4 * self.hidden_size
+
+    @property
+    def hd(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def gpt2_125m() -> "GPT2Config":
+        return GPT2Config()
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "GPT2Config":
+        return GPT2Config(vocab_size=vocab_size, hidden_size=64, num_layers=2,
+                          num_heads=4, max_seq_len=128)
+
+
+def init_params(cfg: GPT2Config, rng) -> dict:
+    d, f, nl = cfg.hidden_size, cfg.ffn, cfg.num_layers
+    k = iter(jax.random.split(rng, 16))
+    std = 0.02
+    out_std = std / jnp.sqrt(2.0 * nl)
+
+    def norm(key, *shape, s=std):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    return {
+        "wte": norm(next(k), cfg.vocab_size, d),
+        "wpe": norm(next(k), cfg.max_seq_len, d, s=0.01),
+        "layers": {
+            "ln1_g": jnp.ones((nl, d)), "ln1_b": jnp.zeros((nl, d)),
+            "wq": norm(next(k), nl, d, d), "bq": jnp.zeros((nl, d)),
+            "wk": norm(next(k), nl, d, d), "bk": jnp.zeros((nl, d)),
+            "wv": norm(next(k), nl, d, d), "bv": jnp.zeros((nl, d)),
+            "wo": norm(next(k), nl, d, d, s=out_std), "bo": jnp.zeros((nl, d)),
+            "ln2_g": jnp.ones((nl, d)), "ln2_b": jnp.zeros((nl, d)),
+            "w_in": norm(next(k), nl, d, f), "b_in": jnp.zeros((nl, f)),
+            "w_out": norm(next(k), nl, f, d, s=out_std), "b_out": jnp.zeros((nl, d)),
+        },
+        "lnf_g": jnp.ones((d,)), "lnf_b": jnp.zeros((d,)),
+    }
+
+
+PARAM_LOGICAL_AXES = {
+    "wte": ("vocab", "embed"),
+    "wpe": (None, "embed"),
+    "layers": {
+        "ln1_g": ("layers", "embed"), "ln1_b": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"), "bq": ("layers", "heads"),
+        "wk": ("layers", "embed", "heads"), "bk": ("layers", "heads"),
+        "wv": ("layers", "embed", "heads"), "bv": ("layers", "heads"),
+        "wo": ("layers", "heads", "embed"), "bo": ("layers", "embed"),
+        "ln2_g": ("layers", "embed"), "ln2_b": ("layers", "embed"),
+        "w_in": ("layers", "embed", "ffn"), "b_in": ("layers", "ffn"),
+        "w_out": ("layers", "ffn", "embed"), "b_out": ("layers", "embed"),
+    },
+    "lnf_g": ("embed",), "lnf_b": ("embed",),
+}
+
+
+def layernorm(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * g.astype(x.dtype)
+            + b.astype(x.dtype))
+
+
+def _block(cfg: GPT2Config, ctx: ShardCtx, attn_impl: str, x, lp):
+    b, s, d = x.shape
+    h = layernorm(x, lp["ln1_g"], lp["ln1_b"], cfg.layer_norm_eps)
+    q = (h @ lp["wq"] + lp["bq"]).reshape(b, s, cfg.num_heads, cfg.hd)
+    kk = (h @ lp["wk"] + lp["bk"]).reshape(b, s, cfg.num_heads, cfg.hd)
+    vv = (h @ lp["wv"] + lp["bv"]).reshape(b, s, cfg.num_heads, cfg.hd)
+    q = ctx.constrain(q, "batch", "seq", "heads_act", None)
+    o = attention(q, kk, vv, causal=True, impl=attn_impl).reshape(b, s, d)
+    x = x + o @ lp["wo"] + lp["bo"]
+    h = layernorm(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
+    h = jax.nn.gelu(h @ lp["w_in"] + lp["b_in"], approximate=True)
+    h = ctx.constrain(h, "batch", "seq", "ffn_act")
+    x = x + h @ lp["w_out"] + lp["b_out"]
+    return ctx.constrain(x, "batch", "seq", "embed_act")
+
+
+def forward(cfg: GPT2Config, params, input_ids, ctx: ShardCtx | None = None,
+            attn_impl: str = "auto", remat: bool = False, remat_policy=None):
+    ctx = ctx or ShardCtx()
+    b, s = input_ids.shape
+    x = params["wte"][input_ids] + params["wpe"][:s][None, :, :]
+    x = ctx.constrain(x, "batch", "seq", "embed_act")
+
+    layer = partial(_block, cfg, ctx, attn_impl)
+    if remat:
+        layer = jax.checkpoint(layer, policy=remat_policy)
+    x, _ = lax.scan(lambda c, lp: (layer(c, lp), None), x, params["layers"])
+    x = layernorm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    logits = x @ params["wte"].T.astype(x.dtype)  # tied head
+    return ctx.constrain(logits, "batch", "seq", "vocab_act")
+
+
+def num_params(cfg: GPT2Config) -> int:
+    d, f = cfg.hidden_size, cfg.ffn
+    per_layer = 4 * d * d + 4 * d + 2 * d * f + d + f + 4 * d
+    return cfg.vocab_size * d + cfg.max_seq_len * d + cfg.num_layers * per_layer + 2 * d
+
+
+def flops_per_token(cfg: GPT2Config, seq_len: int) -> float:
+    return 6.0 * num_params(cfg) + 12.0 * cfg.num_layers * cfg.hidden_size * seq_len / 2.0
+
+
+def build(cfg: GPT2Config, ctx: ShardCtx | None = None, attn_impl: str = "auto",
+          remat: bool = False, remat_policy=None) -> ModelSpec:
+    ctx = ctx or ShardCtx()
+    fwd = partial(forward, cfg, ctx=ctx, attn_impl=attn_impl,
+                  remat=remat, remat_policy=remat_policy)
+
+    def loss_fn(params, batch, rng=None):
+        del rng
+        logits = fwd(params, batch["input_ids"])
+        return causal_lm_loss(logits, batch["input_ids"], batch.get("labels"))
+
+    return ModelSpec(
+        name="gpt2",
+        config=cfg,
+        init_fn=partial(init_params, cfg),
+        loss_fn=loss_fn,
+        forward_fn=fwd,
+        param_logical_axes=PARAM_LOGICAL_AXES,
+        num_params=num_params(cfg),
+        flops_per_token=partial(flops_per_token, cfg),
+    )

@@ -1,0 +1,198 @@
+"""Llama-family causal LM (Llama 2/3 architecture), TPU-first.
+
+The flagship training model (BASELINE.json north star: Llama-3-8B ZeRO-3).
+Functional design: parameters are a pytree with a *stacked* leading layer dim,
+the decoder runs as one ``lax.scan`` over that stack — one compiled layer body
+regardless of depth (fast compiles, natural pipeline partitioning, uniform
+remat). The reference has no model zoo for training; its inference engine ships
+per-arch implementations (``inference/v2/model_implementations/llama_v2``);
+this module is the training+inference source of truth for the family.
+
+Architecture: RMSNorm, SwiGLU MLP, RoPE, grouped-query attention, optional
+tied embeddings — matching HF ``LlamaForCausalLM`` semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.models.api import ModelSpec, ShardCtx, causal_lm_loss, count_params
+from deepspeed_tpu.ops.attention import apply_rope, attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int | None = None
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    tie_embeddings: bool = False
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                           num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+                           max_seq_len=8192)
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+                           num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128)
+
+
+def init_params(cfg: LlamaConfig, rng) -> dict:
+    """fp32 master weights; scaled init on residual-out projections."""
+    d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.hd
+    hq, hkv, nl = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+    k = iter(jax.random.split(rng, 16))
+    std = 0.02
+    out_std = std / jnp.sqrt(2.0 * nl)
+
+    def norm(key, *shape, s=std):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    params = {
+        "embed": norm(next(k), cfg.vocab_size, d),
+        "layers": {
+            "attn_norm": jnp.ones((nl, d), jnp.float32),
+            "wq": norm(next(k), nl, d, hq * hd),
+            "wk": norm(next(k), nl, d, hkv * hd),
+            "wv": norm(next(k), nl, d, hkv * hd),
+            "wo": norm(next(k), nl, hq * hd, d, s=out_std),
+            "mlp_norm": jnp.ones((nl, d), jnp.float32),
+            "w_gate": norm(next(k), nl, d, f),
+            "w_up": norm(next(k), nl, d, f),
+            "w_down": norm(next(k), nl, f, d, s=out_std),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(next(k), d, cfg.vocab_size)
+    return params
+
+
+PARAM_LOGICAL_AXES = {
+    "embed": ("vocab", "embed"),
+    "layers": {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "ffn"),
+        "w_up": ("layers", "embed", "ffn"),
+        "w_down": ("layers", "ffn", "embed"),
+    },
+    "final_norm": ("embed",),
+    "lm_head": ("embed", "vocab"),
+}
+
+
+def rmsnorm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _decoder_layer(cfg: LlamaConfig, ctx: ShardCtx, attn_impl: str,
+                   x: jnp.ndarray, lp: dict, positions: jnp.ndarray) -> jnp.ndarray:
+    b, s, d = x.shape
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, hq, hd)
+    kk = (h @ lp["wk"]).reshape(b, s, hkv, hd)
+    vv = (h @ lp["wv"]).reshape(b, s, hkv, hd)
+    q = ctx.constrain(q, "batch", "seq", "heads_act", None)
+    kk = ctx.constrain(kk, "batch", "seq", "heads_act", None)
+    q, kk = apply_rope(q, kk, positions, cfg.rope_theta)
+    o = attention(q, kk, vv, causal=True, impl=attn_impl)
+    x = x + o.reshape(b, s, hq * hd) @ lp["wo"]
+    x = ctx.constrain(x, "batch", "seq", "embed_act")
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"])
+    up = h @ lp["w_up"]
+    gate = ctx.constrain(gate, "batch", "seq", "ffn_act")
+    x = x + (gate * up) @ lp["w_down"]
+    return ctx.constrain(x, "batch", "seq", "embed_act")
+
+
+def forward(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
+            ctx: ShardCtx | None = None, attn_impl: str = "auto",
+            remat_policy=None, remat: bool = False) -> jnp.ndarray:
+    """[B, S] int tokens -> [B, S, V] logits. Decoder is a scan over the layer stack."""
+    ctx = ctx or ShardCtx()
+    b, s = input_ids.shape
+    x = params["embed"].astype(params["embed"].dtype)[input_ids]
+    x = ctx.constrain(x, "batch", "seq", "embed_act")
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+
+    layer = partial(_decoder_layer, cfg, ctx, attn_impl)
+    if remat:
+        layer = jax.checkpoint(layer, policy=remat_policy)
+
+    def body(carry, lp):
+        return layer(carry, lp, positions), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = x @ head.astype(x.dtype)
+    return ctx.constrain(logits, "batch", "seq", "vocab_act")
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.hd
+    per_layer = d * hd * (cfg.num_heads * 2 + cfg.num_kv_heads * 2) + 3 * d * f + 2 * d
+    total = cfg.vocab_size * d + cfg.num_layers * per_layer + d
+    if not cfg.tie_embeddings:
+        total += d * cfg.vocab_size
+    return total
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """6*N matmul flops + attention term 12*L*D*S (causal halves the 2x)."""
+    return 6.0 * num_params(cfg) + 12.0 * cfg.num_layers * cfg.hidden_size * seq_len / 2.0
+
+
+def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto",
+          remat: bool = False, remat_policy=None) -> ModelSpec:
+    ctx = ctx or ShardCtx()
+    fwd = partial(forward, cfg, ctx=ctx, attn_impl=attn_impl,
+                  remat=remat, remat_policy=remat_policy)
+
+    def loss_fn(params, batch, rng=None):
+        del rng  # no dropout in llama
+        logits = fwd(params, batch["input_ids"])
+        return causal_lm_loss(logits, batch["input_ids"], batch.get("labels"))
+
+    axes = dict(PARAM_LOGICAL_AXES)
+    if cfg.tie_embeddings:
+        axes = {k: v for k, v in axes.items() if k != "lm_head"}
+    return ModelSpec(
+        name="llama",
+        config=cfg,
+        init_fn=partial(init_params, cfg),
+        loss_fn=loss_fn,
+        forward_fn=fwd,
+        param_logical_axes=axes,
+        num_params=num_params(cfg),
+        flops_per_token=partial(flops_per_token, cfg),
+    )

@@ -1,0 +1,112 @@
+"""Attention ops with implementation dispatch.
+
+Role parity with the reference's attention kernel stack
+(``csrc/transformer/inference`` softmax/rope kernels, v2 ``ragged_ops`` blocked
+flash attention) — on TPU the hot path is a Pallas flash-attention kernel
+(``ops/pallas/flash_attention.py``); the reference path is a stable-softmax XLA
+einsum that the compiler fuses well on the MXU. ``impl="auto"`` picks Pallas on
+TPU for supported shapes, XLA otherwise.
+
+Layouts: q [B, Sq, Hq, D], k/v [B, Skv, Hkv, D] (GQA: Hq % Hkv == 0).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand KV heads for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def xla_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    bias: jnp.ndarray | None = None,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Reference attention: fp32 stable softmax, MXU-friendly einsums."""
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        # offset supports decode (q is a suffix of the kv sequence)
+        idx_q = jnp.arange(sq)[:, None] + (sk - sq)
+        idx_k = jnp.arange(sk)[None, :]
+        mask = idx_q >= idx_k
+        scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+    return out
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    bias: jnp.ndarray | None = None,
+    scale: float | None = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Dispatching attention entry point used by all models."""
+    if impl == "auto":
+        impl = "pallas" if (_on_tpu() and bias is None) else "xla"
+    if impl == "pallas":
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except (ImportError, NotImplementedError):
+            impl = "xla"
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal, bias=bias, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def apply_rope(q, k, positions, theta: float = 10000.0):
+    """Rotary position embedding (reference: ``apply_rotary_pos_emb`` kernels,
+    ``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu``).
+
+    q/k: [B, S, H, D]; positions: [B, S] absolute positions.
+    """
+    d = q.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., :half], x[..., half:]
+        xr1 = x1.astype(jnp.float32) * cos - x2.astype(jnp.float32) * sin
+        xr2 = x2.astype(jnp.float32) * cos + x1.astype(jnp.float32) * sin
+        return jnp.concatenate([xr1, xr2], axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
