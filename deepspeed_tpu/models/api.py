@@ -82,6 +82,9 @@ class ModelSpec:
     forward_fn: Callable
     # pytree congruent to params: tuple of logical axis names per dim
     param_logical_axes: Any = None
+    # unit counts per logical axis (e.g. {"kv_heads": 8}) for shard-granularity
+    # checks (reference tp_shard.py kv-head-aware sharding)
+    logical_dim_units: dict = field(default_factory=dict)
     # analytics for MFU / flops profiler
     num_params: int = 0
     flops_per_token: Callable[[int], float] | None = None

@@ -157,6 +157,7 @@ def build(cfg: GPT2Config, ctx: ShardCtx | None = None, attn_impl: str = "auto",
         loss_fn=loss_fn,
         forward_fn=fwd,
         param_logical_axes=PARAM_LOGICAL_AXES,
+        logical_dim_units={"heads": cfg.num_heads},
         num_params=num_params(cfg),
         flops_per_token=partial(flops_per_token, cfg),
     )

@@ -193,6 +193,7 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         loss_fn=loss_fn,
         forward_fn=fwd,
         param_logical_axes=axes,
+        logical_dim_units={"heads": cfg.num_heads, "kv_heads": cfg.num_kv_heads},
         num_params=num_params(cfg),
         flops_per_token=partial(flops_per_token, cfg),
     )

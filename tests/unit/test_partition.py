@@ -1,0 +1,127 @@
+"""Sharding-planner semantics on the 8-device CPU mesh
+(reference analogs: ZeRO stage layouts, AutoTP kv-head-aware sharding)."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.comm import init_distributed
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.parallel.partition import opt_state_shardings, plan_sharding, shard_params
+
+
+@pytest.fixture
+def tiny():
+    spec = llama.build(llama.LlamaConfig.tiny())  # d=64 f=128 hq=4 hkv=2 L=2
+    params = spec.init_fn(jax.random.PRNGKey(0))
+    return spec, params
+
+
+def _plan(spec, params, topo, stage, **kw):
+    return plan_sharding(spec.param_logical_axes, params, topo, zero_stage=stage,
+                         dim_units=spec.logical_dim_units, **kw)
+
+
+def test_stage0_replicated(tiny):
+    spec, params = tiny
+    topo = init_distributed(MeshConfig(data=8))
+    plan = _plan(spec, params, topo, 0)
+    for s in jax.tree_util.tree_leaves(plan.param_specs, is_leaf=lambda x: isinstance(x, P)):
+        assert s == P(*([None] * len(s)))
+
+
+def test_stage3_shards_params_over_fsdp(tiny):
+    spec, params = tiny
+    topo = init_distributed(MeshConfig(data=1, fsdp=8))
+    plan = _plan(spec, params, topo, 3)
+    # wq [2, 64, 64]: largest within-layer dim sharded over fsdp, layers dim untouched
+    assert plan.param_specs["layers"]["wq"] == P(None, "fsdp", None) or \
+           plan.param_specs["layers"]["wq"] == P(None, None, "fsdp")
+    assert plan.param_specs["embed"][0] is None or "fsdp" in str(plan.param_specs["embed"])
+    # grads and opt shards match param layout at stage 3
+    assert plan.grad_specs == plan.param_specs == plan.shard_specs
+
+
+def test_stage2_grads_sharded_params_replicated(tiny):
+    spec, params = tiny
+    topo = init_distributed(MeshConfig(data=1, fsdp=8))
+    plan = _plan(spec, params, topo, 2)
+    wq_param = plan.param_specs["layers"]["wq"]
+    wq_grad = plan.grad_specs["layers"]["wq"]
+    assert wq_param == P(None, None, None)
+    assert "fsdp" in [a for a in wq_grad if a is not None]
+
+
+def test_stage1_only_opt_sharded(tiny):
+    spec, params = tiny
+    topo = init_distributed(MeshConfig(data=1, fsdp=8))
+    plan = _plan(spec, params, topo, 1)
+    assert plan.grad_specs == plan.param_specs  # grads replicated like params
+    assert plan.shard_specs != plan.param_specs  # but opt template is sharded
+
+
+def test_tp_head_sharding_and_kv_guard(tiny):
+    spec, params = tiny
+    # tensor=4: q heads (4) shardable; kv heads (2) NOT (2 % 4 != 0)
+    topo = init_distributed(MeshConfig(data=2, tensor=4))
+    plan = _plan(spec, params, topo, 0)
+    assert plan.param_specs["layers"]["wq"] == P(None, None, "tensor")
+    assert plan.param_specs["layers"]["wk"] == P(None, None, None)  # kv-head guard
+    assert plan.param_specs["layers"]["w_gate"] == P(None, None, "tensor")
+    assert plan.param_specs["layers"]["w_down"] == P(None, "tensor", None)
+    assert plan.param_specs["embed"] == P("tensor", None)
+
+    # tensor=2: kv heads shardable now
+    topo = init_distributed(MeshConfig(data=4, tensor=2))
+    plan = _plan(spec, params, topo, 0)
+    assert plan.param_specs["layers"]["wk"] == P(None, None, "tensor")
+
+
+def test_tp_plus_fsdp_compose(tiny):
+    spec, params = tiny
+    topo = init_distributed(MeshConfig(data=1, fsdp=2, tensor=4))
+    plan = _plan(spec, params, topo, 3)
+    wq = plan.param_specs["layers"]["wq"]
+    assert wq == P(None, "fsdp", "tensor")
+
+
+def test_persistence_threshold_keeps_small_params_replicated(tiny):
+    spec, params = tiny
+    topo = init_distributed(MeshConfig(data=1, fsdp=8))
+    plan = _plan(spec, params, topo, 3, persistence_threshold=500)
+    # norms (2*64 = 128 elems) stay replicated; big matrices shard
+    assert plan.param_specs["layers"]["attn_norm"] == P(None, None)
+    assert "fsdp" in [a for a in plan.param_specs["layers"]["wq"] if a is not None]
+
+
+def test_batch_spec(tiny):
+    spec, params = tiny
+    topo = init_distributed(MeshConfig(data=2, fsdp=2, sequence=2))
+    plan = _plan(spec, params, topo, 3)
+    assert plan.batch_spec == P(("data", "fsdp"), "sequence")
+
+
+def test_shard_params_places_arrays(tiny):
+    spec, params = tiny
+    topo = init_distributed(MeshConfig(data=1, fsdp=8))
+    plan = _plan(spec, params, topo, 3)
+    sharded = shard_params(params, plan)
+    wq = sharded["layers"]["wq"]
+    assert len(wq.sharding.device_set) == 8
+    # each device holds 1/8 of the array
+    assert wq.addressable_shards[0].data.size == wq.size // 8
+
+
+def test_opt_state_sharding_inference(tiny):
+    spec, params = tiny
+    topo = init_distributed(MeshConfig(data=1, fsdp=8))
+    plan = _plan(spec, params, topo, 1)
+    opt = optax.adam(1e-3)
+    shardings = opt_state_shardings(opt, params, plan)
+    state = jax.jit(opt.init, out_shardings=shardings)(params)
+    # moments are sharded like the stage-3 layout even though params replicate
+    mu_wq = state[0].mu["layers"]["wq"]
+    assert mu_wq.addressable_shards[0].data.size == mu_wq.size // 8
