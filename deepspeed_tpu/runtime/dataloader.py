@@ -1,0 +1,60 @@
+"""Data loading utilities.
+
+Role parity with the reference ``runtime/dataloader.py`` (``DeepSpeedDataLoader:41``
++ ``RepeatingLoader:17``) and the test fixtures' random/sequence loaders
+(``tests/unit/simple_model.py:268-290``). The engine consumes any iterator of
+``dict[str, np.ndarray]`` microbatches with a global batch dimension; helpers
+here build such iterators from arrays or token streams.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterable so it restarts on StopIteration (reference ``RepeatingLoader:17``)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self._iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = iter(self.loader)
+            return next(self._iter)
+
+
+def array_loader(
+    arrays: dict, batch_size: int, seed: int = 0, shuffle: bool = True, drop_last: bool = True
+) -> Iterator[dict]:
+    """Yield dict microbatches from same-length arrays, reshuffled each epoch."""
+    n = len(next(iter(arrays.values())))
+    rng = np.random.default_rng(seed)
+    while True:
+        idx = rng.permutation(n) if shuffle else np.arange(n)
+        end = (n // batch_size) * batch_size if drop_last else n
+        for start in range(0, end, batch_size):
+            sel = idx[start : start + batch_size]
+            yield {k: np.asarray(v)[sel] for k, v in arrays.items()}
+        if not shuffle:
+            return
+
+
+def random_token_loader(
+    batch_size: int, seq_len: int, vocab_size: int, seed: int = 0
+) -> Iterator[dict]:
+    """Endless random-token batches (test/bench fixture; reference
+    ``simple_model.py`` random loaders)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {
+            "input_ids": rng.integers(0, vocab_size, (batch_size, seq_len), dtype=np.int32)
+        }

@@ -1,0 +1,455 @@
+"""The training engine.
+
+Role parity with the reference ``runtime/engine.py:235 DeepSpeedEngine`` —
+config-driven assembly of model + optimizer + schedules + precision + ZeRO
+sharding + monitoring, exposing the fwd/bwd/step protocol and the fused
+``train_batch``.
+
+TPU-native architecture (not a port):
+- The hot path is ONE jitted function per engine: microbatch ``lax.scan`` over
+  the gradient-accumulation dim, grad accumulation in fp32 under the ZeRO
+  gradient sharding, loss-scale bookkeeping, clip, fused optimizer update and
+  loss-scale skip — all inside a single XLA program. The reference's
+  IPG buckets / overlapped reduce streams (``stage_1_and_2.py:1277
+  average_tensor``, ``stage3.py:1488 __reduce_and_partition_ipg_grads``)
+  collapse into a single reduce at the scan boundary, scheduled by XLA.
+- ZeRO stages are the sharding plan (``parallel/partition.py``); no hooks, no
+  trace cache: XLA's latency-hiding scheduler prefetches next-layer allgathers
+  (the stage-3 coordinator's job, ``partitioned_param_coordinator.py:73``).
+- ``forward``/``backward``/``step`` remain for API parity
+  (``engine.py:2675/3066/3241``): ``backward`` accumulates into a persistent
+  sharded gradient buffer, ``step`` applies at the GAS boundary exactly like
+  ``_take_model_step:3168``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.comm import comm as dist
+from deepspeed_tpu.comm.topology import MeshTopology, get_topology, topology_initialized
+from deepspeed_tpu.config.config import Config, load_config
+from deepspeed_tpu.models.api import ModelSpec, ShardCtx
+from deepspeed_tpu.ops.optimizers import base_lr, build_optimizer
+from deepspeed_tpu.parallel.partition import (
+    ShardingPlan,
+    opt_state_shardings,
+    plan_sharding,
+)
+from deepspeed_tpu.runtime import precision
+from deepspeed_tpu.runtime.lr_schedules import LRScheduler, build_schedule
+from deepspeed_tpu.runtime.precision import LossScaleState
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.timer import ThroughputTimer
+
+REMAT_POLICIES = {
+    "full": None,
+    "dots_saveable": "dots_saveable",
+    "nothing_saveable": "nothing_saveable",
+    "offload_dots": "save_dot_with_no_batch_dims_but_offload",
+}
+
+
+def _resolve_remat_policy(name: str):
+    key = REMAT_POLICIES.get(name)
+    if key is None:
+        return None
+    pol = getattr(jax.checkpoint_policies, key, None)
+    if pol is None and name == "offload_dots":
+        pol = getattr(jax.checkpoint_policies, "dots_saveable", None)
+    return pol
+
+
+def _global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+def _tree_select(pred, new, old):
+    return jax.tree_util.tree_map(lambda n, o: jnp.where(pred, n, o), new, old)
+
+
+class Engine:
+    """Config-driven training engine over a ModelSpec."""
+
+    def __init__(
+        self,
+        model: ModelSpec | Callable[[ShardCtx], ModelSpec],
+        config: Config,
+        topo: MeshTopology,
+        training_data: Iterator | None = None,
+        seed: int | None = None,
+    ):
+        self.config = config
+        self.topo = topo
+        self.shard_ctx = ShardCtx(mesh=topo.mesh)
+        self.model_spec = model(self.shard_ctx) if callable(model) else model
+        self.training_dataloader = training_data
+
+        zero = config.zero_optimization
+        self.zero_stage = zero.stage
+        self.plan: ShardingPlan = plan_sharding(
+            self.model_spec.param_logical_axes,
+            jax.eval_shape(self.model_spec.init_fn, jax.random.PRNGKey(0)),
+            topo,
+            zero_stage=zero.stage,
+            use_tp=topo.size("tensor") > 1,
+            dim_units=self.model_spec.logical_dim_units,
+            persistence_threshold=zero.persistence_threshold,
+        )
+
+        # ---- params (fp32 master), placed per plan (reference zero.Init analog)
+        seed = seed if seed is not None else config.seed
+        init_rng = jax.random.PRNGKey(seed)
+        self.params = jax.jit(
+            self.model_spec.init_fn, out_shardings=self.plan.param_shardings
+        )(init_rng)
+
+        # ---- optimizer (lr=1.0; schedule applied inside the step for exact
+        # logged-lr == applied-lr, including skipped-step semantics)
+        self._base_lr = base_lr(config.optimizer)
+        self.lr_schedule = build_schedule(config.scheduler, self._base_lr)
+        self.optimizer = build_optimizer(config.optimizer, learning_rate=1.0)
+        self._opt_shardings = opt_state_shardings(self.optimizer, self.params, self.plan)
+        self.opt_state = jax.jit(
+            self.optimizer.init, out_shardings=self._opt_shardings
+        )(self.params)
+
+        self.scale_state: LossScaleState = precision.init_loss_scale(config.fp16)
+        self.lr_scheduler = LRScheduler(self.lr_schedule)
+
+        # ---- counters (reference engine attributes)
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self._last_metrics: dict = {}
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+        # ---- grad accumulation buffer for the fwd/bwd parity path
+        self._acc_grads = None
+        self._acc_count = 0
+
+        self.tput_timer = ThroughputTimer(
+            batch_size=config.train_batch_size or 1,
+            steps_per_output=config.steps_per_print,
+        )
+        if self.model_spec.flops_per_token and config.sequence_length:
+            self.tput_timer.flops_per_sample = (
+                self.model_spec.flops_per_token(config.sequence_length)
+                * config.sequence_length
+            )
+
+        self._train_batch_jit = None
+        self._accum_jit = None
+        self._apply_jit = None
+        self._eval_jit = None
+        log_dist(
+            f"Engine: model={self.model_spec.name} params={self.model_spec.num_params:,} "
+            f"zero_stage={self.zero_stage} precision={config.precision_name} "
+            f"mesh={topo.describe()} batch={config.train_batch_size}"
+            f"(micro={config.train_micro_batch_size_per_device} x gas="
+            f"{config.gradient_accumulation_steps} x dp={topo.dp_world_size})",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------ internals
+    @property
+    def gas(self) -> int:
+        return int(self.config.gradient_accumulation_steps or 1)
+
+    def _grad_ns(self):
+        return self.plan.grad_shardings
+
+    def _constrain_grads(self, grads):
+        ns = self._grad_ns()
+        return jax.tree_util.tree_map(
+            lambda g, s: jax.lax.with_sharding_constraint(g.astype(jnp.float32), s),
+            grads,
+            ns,
+        )
+
+    def _microbatch_grads(self, params, mb, rng, scale):
+        """Scaled-loss grads for one microbatch, fp32, ZeRO-sharded."""
+        cparams = precision.cast_to_compute(params, self.config.compute_dtype)
+
+        def scaled_loss(cp):
+            loss = self.model_spec.loss_fn(cp, mb, rng)
+            return loss * scale
+
+        loss_scaled, grads = jax.value_and_grad(scaled_loss)(cparams)
+        return loss_scaled / scale, self._constrain_grads(grads)
+
+    def _update(self, params, opt_state, scale_state, grad_sum, n_micro, step):
+        """Shared optimizer-step tail (reference ``_take_model_step:3168``):
+        unscale, overflow check, clip, update, loss-scale bookkeeping."""
+        cfg = self.config
+        denom = scale_state.scale * n_micro
+        grads = jax.tree_util.tree_map(lambda g: g / denom, grad_sum)
+        finite = precision.grads_finite(grads)
+        gnorm = _global_norm(grads)
+        if cfg.gradient_clipping > 0:
+            coef = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
+            grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
+        lr = self.lr_schedule(step)
+        updates, new_opt = self.optimizer.update(grads, opt_state, params)
+        updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
+        new_params = optax.apply_updates(params, updates)
+        new_params = _tree_select(finite, new_params, params)
+        new_opt = _tree_select(finite, new_opt, opt_state)
+        new_scale = precision.update_loss_scale(scale_state, finite, cfg.fp16)
+        metrics = {
+            "grad_norm": gnorm,
+            "lr": lr,
+            "loss_scale": scale_state.scale,
+            "skipped": jnp.logical_not(finite),
+        }
+        return new_params, new_opt, new_scale, metrics
+
+    def _build_train_batch_fn(self):
+        gas = self.gas
+
+        def train_batch_fn(params, opt_state, scale_state, step, rng, batch):
+            scale = scale_state.scale
+            acc0 = jax.tree_util.tree_map(
+                lambda p, s: jax.lax.with_sharding_constraint(
+                    jnp.zeros(p.shape, jnp.float32), s
+                ),
+                params,
+                self._grad_ns(),
+            )
+
+            def micro(acc, idx_mb):
+                idx, mb = idx_mb
+                r = jax.random.fold_in(rng, idx)
+                loss, grads = self._microbatch_grads(params, mb, r, scale)
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                return acc, loss
+
+            acc, losses = jax.lax.scan(micro, acc0, (jnp.arange(gas), batch))
+            new_params, new_opt, new_scale, metrics = self._update(
+                params, opt_state, scale_state, acc, float(gas), step
+            )
+            metrics["loss"] = jnp.mean(losses)
+            return new_params, new_opt, new_scale, metrics
+
+        return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2))
+
+    def _build_accum_fn(self):
+        def accum_fn(params, acc, scale_state, rng, mb):
+            loss, grads = self._microbatch_grads(params, mb, rng, scale_state.scale)
+            acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            return loss, acc
+
+        return jax.jit(accum_fn, donate_argnums=(1,))
+
+    def _build_apply_fn(self):
+        def apply_fn(params, opt_state, scale_state, acc, n_micro, step):
+            return self._update(params, opt_state, scale_state, acc, n_micro, step)
+
+        return jax.jit(apply_fn, donate_argnums=(0, 1, 2, 3))
+
+    def _build_eval_fn(self):
+        def eval_fn(params, batch, rng):
+            cparams = precision.cast_to_compute(params, self.config.compute_dtype)
+            return self.model_spec.loss_fn(cparams, batch, rng)
+
+        return jax.jit(eval_fn)
+
+    # ------------------------------------------------------------------ data prep
+    def _batch_sharding(self, ndim: int, leading_gas: bool):
+        spec = list(self.plan.batch_spec)
+        dims = ([None] if leading_gas else []) + spec
+        dims += [None] * (ndim - len(dims))
+        return NamedSharding(self.topo.mesh, PartitionSpec(*dims[:ndim]))
+
+    def _put_microbatch(self, batch: dict):
+        return {
+            k: jax.device_put(np.asarray(v), self._batch_sharding(np.asarray(v).ndim, False))
+            for k, v in batch.items()
+        }
+
+    def _put_gas_batch(self, batch: dict):
+        """[B_global, ...] -> [GAS, micro*dp, ...] placed on the mesh."""
+        out = {}
+        gas = self.gas
+        for k, v in batch.items():
+            v = np.asarray(v)
+            if v.shape[0] % gas:
+                raise ValueError(
+                    f"batch dim {v.shape[0]} not divisible by GAS {gas} for '{k}'"
+                )
+            v = v.reshape((gas, v.shape[0] // gas) + v.shape[1:])
+            out[k] = jax.device_put(v, self._batch_sharding(v.ndim, True))
+        return out
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ------------------------------------------------------------------ public API
+    def train_batch(self, batch: dict | None = None, data_iter: Iterator | None = None):
+        """Fused full step: GAS microbatches + optimizer update in one XLA program
+        (reference ``PipelineEngine.train_batch:337`` / engine fwd+bwd+step loop)."""
+        if batch is None:
+            if data_iter is None:
+                if self.training_dataloader is None:
+                    raise ValueError("train_batch needs a batch, data_iter, or training_data")
+                data_iter = self.training_dataloader
+            micro = [next(data_iter) for _ in range(self.gas)]
+            batch = {k: np.concatenate([np.asarray(m[k]) for m in micro]) for k in micro[0]}
+        if self._train_batch_jit is None:
+            self._train_batch_jit = self._build_train_batch_fn()
+        dev_batch = self._put_gas_batch(batch)
+        self.tput_timer.start()
+        self.params, self.opt_state, self.scale_state, metrics = self._train_batch_jit(
+            self.params,
+            self.opt_state,
+            self.scale_state,
+            jnp.int32(self.global_steps),
+            self._next_rng(),
+            dev_batch,
+        )
+        metrics["loss"].block_until_ready()
+        self.tput_timer.stop(global_step=True)
+        self._after_step(metrics)
+        self.micro_steps += self.gas
+        return metrics["loss"]
+
+    def forward(self, batch: dict):
+        """Eval-mode loss (reference ``engine.forward:2675``; jitted, no grads)."""
+        if self._eval_jit is None:
+            self._eval_jit = self._build_eval_fn()
+        return self._eval_jit(self.params, self._put_microbatch(batch), self._next_rng())
+
+    eval_batch = forward
+
+    def backward(self, batch: dict):
+        """Accumulate gradients for one microbatch (reference ``backward:3066``).
+
+        Returns the (unscaled) loss. Gradients live in a persistent buffer
+        sharded per the ZeRO plan until ``step()`` consumes them.
+        """
+        if self._accum_jit is None:
+            self._accum_jit = self._build_accum_fn()
+        if self._acc_grads is None:
+            self._acc_grads = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(jnp.zeros(p.shape, jnp.float32), s),
+                self.params,
+                self._grad_ns(),
+            )
+            self._acc_count = 0
+        loss, self._acc_grads = self._accum_jit(
+            self.params,
+            self._acc_grads,
+            self.scale_state,
+            self._next_rng(),
+            self._put_microbatch(batch),
+        )
+        self._acc_count += 1
+        self.micro_steps += 1
+        return loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        """Reference ``engine.py:3116``."""
+        return self._acc_count >= self.gas
+
+    def step(self):
+        """Apply the accumulated gradients at the GAS boundary
+        (reference ``step:3241`` / ``_take_model_step:3168``)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        if self._apply_jit is None:
+            self._apply_jit = self._build_apply_fn()
+        self.params, self.opt_state, self.scale_state, metrics = self._apply_jit(
+            self.params,
+            self.opt_state,
+            self.scale_state,
+            self._acc_grads,
+            jnp.float32(self._acc_count),
+            jnp.int32(self.global_steps),
+        )
+        self._acc_grads = None
+        self._acc_count = 0
+        self._after_step(metrics)
+
+    def _after_step(self, metrics):
+        self.global_steps += 1
+        self.global_samples += int(self.config.train_batch_size or 0)
+        skipped = bool(metrics["skipped"])
+        if skipped:
+            self.skipped_steps += 1
+            log_dist(
+                f"step {self.global_steps}: overflow, skipping update "
+                f"(loss_scale -> {float(self.scale_state.scale)})",
+                ranks=[0],
+            )
+        self.lr_scheduler.step()
+        self._last_metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        if self.config.steps_per_print and self.global_steps % self.config.steps_per_print == 0:
+            loss = self._last_metrics.get("loss")
+            loss_str = f"loss={float(loss):.4f} " if loss is not None else ""
+            log_dist(
+                f"step={self.global_steps} {loss_str}"
+                f"lr={float(self._last_metrics['lr']):.3e} "
+                f"grad_norm={float(self._last_metrics['grad_norm']):.3f}",
+                ranks=[0],
+            )
+
+    # ------------------------------------------------------------------ accessors
+    @property
+    def loss_scale(self) -> float:
+        return float(self.scale_state.scale)
+
+    def get_lr(self):
+        return [float(self.lr_schedule(jnp.int32(max(0, self.global_steps - 1))))]
+
+    def get_global_grad_norm(self) -> float:
+        gn = self._last_metrics.get("grad_norm")
+        return float(gn) if gn is not None else 0.0
+
+    @property
+    def train_batch_size(self) -> int:
+        return int(self.config.train_batch_size)
+
+    def module_state(self):
+        return self.params
+
+    def monitor_memory(self):
+        from deepspeed_tpu.accelerator.real_accelerator import get_accelerator
+
+        return get_accelerator().memory_stats()
+
+
+def initialize(
+    model: ModelSpec | Callable[[ShardCtx], ModelSpec] | None = None,
+    config: Config | dict | str | None = None,
+    training_data: Iterator | None = None,
+    mesh_devices: list | None = None,
+    seed: int | None = None,
+    **_ignored,
+):
+    """Build the engine (reference ``deepspeed.initialize`` ``__init__.py:93``).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    if model is None:
+        raise ValueError("initialize() requires a model (ModelSpec or builder callable)")
+    cfg = load_config(config)
+    if topology_initialized():
+        topo = get_topology()
+    else:
+        topo = dist.init_distributed(cfg.mesh, devices=mesh_devices)
+    cfg.resolve_batch_sizes(topo.dp_world_size)
+    dist.configure(cfg.comms_logger)
+    engine = Engine(model, cfg, topo, training_data=training_data, seed=seed)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
