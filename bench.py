@@ -1,0 +1,114 @@
+"""Single-chip training throughput benchmark.
+
+Trains the flagship Llama-family decoder for a few steps on the local
+accelerator (the driver runs this on one real TPU chip) and reports model FLOPs
+utilization. Target from BASELINE.json: Llama-3-8B ZeRO-3 bf16 @ >=45% MFU on
+v5p-64; single-chip MFU is the per-chip proxy tracked across rounds
+(``vs_baseline`` = MFU / 0.45).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _peak_flops(device) -> float:
+    """bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "v5 lite": 197e12, "v5e": 197e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v4": 275e12,
+        "v6 lite": 918e12, "v6e": 918e12,
+        "v3": 123e12, "v2": 45e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12  # conservative default
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    # Sized to fit one chip's HBM with fp32 master + Adam moments (~18 B/param).
+    model_cfg = llama.LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_VOCAB", 32000)),
+        hidden_size=int(os.environ.get("BENCH_HIDDEN", 1024)),
+        intermediate_size=int(os.environ.get("BENCH_FFN", 2816)),
+        num_layers=int(os.environ.get("BENCH_LAYERS", 12)),
+        num_heads=16,
+        num_kv_heads=8,
+        max_seq_len=2048,
+    ) if on_tpu else llama.LlamaConfig.tiny(512)
+
+    seq = int(os.environ.get("BENCH_SEQ", 2048)) if on_tpu else 64
+    batch = int(os.environ.get("BENCH_BATCH", 8)) if on_tpu else 4
+    steps = int(os.environ.get("BENCH_STEPS", 10)) if on_tpu else 3
+
+    config = {
+        "train_micro_batch_size_per_device": batch,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 0,
+        "gradient_clipping": 1.0,
+        "sequence_length": seq,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": -1},
+        "activation_checkpointing": {"enabled": True, "policy": "dots_saveable"},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(
+            model_cfg, ctx=ctx, remat=True,
+            remat_policy=None,
+        ),
+        config=config,
+    )
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return {"input_ids": rng.integers(0, model_cfg.vocab_size, (batch, seq), dtype=np.int32)}
+
+    # warmup/compile
+    engine.train_batch(make_batch())
+    engine.train_batch(make_batch())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(make_batch())
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_s = steps * batch * seq / elapsed
+    n = llama.num_params(model_cfg)
+    flops_per_token = llama.flops_per_token(model_cfg, seq)
+    model_flops_per_s = tokens_per_s * flops_per_token
+    peak = _peak_flops(jax.devices()[0]) if on_tpu else 1e12
+    mfu = model_flops_per_s / peak
+
+    result = {
+        "metric": "llama_train_mfu_single_chip",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "tokens_per_s": round(tokens_per_s, 1),
+        "model_params": n,
+        "seq_len": seq,
+        "final_loss": round(float(loss), 4),
+        "device": str(jax.devices()[0].device_kind),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -168,7 +168,8 @@ def num_params(cfg: LlamaConfig) -> int:
 
 
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
-    """6*N matmul flops + attention term 12*L*D*S (causal halves the 2x)."""
+    """Training FLOPs/token (PaLM convention): 6*N matmul + 6*L*D*S causal attention
+    (12*L*D*S non-causal, halved)."""
     return 6.0 * num_params(cfg) + 12.0 * cfg.num_layers * cfg.hidden_size * seq_len / 2.0
 
 
