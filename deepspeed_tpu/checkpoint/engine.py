@@ -1,0 +1,104 @@
+"""Checkpoint engines: pluggable serializers + async/decoupled writer.
+
+Role parity with the reference's ``runtime/checkpoint_engine/checkpoint_engine.py:21``
+(``CheckpointEngine`` ABC; torch/Nebula/DataStates/Fast/decoupled impls) and the
+engine-side layout (``runtime/engine.py:4557 save_checkpoint``: tagged dirs,
+``latest`` pointer file, tag validation, optional async commit off the critical
+path).
+
+Layout per checkpoint:
+    {save_dir}/{tag}/manifest.json     config dump + counters + client state
+    {save_dir}/{tag}/model.npz         full param arrays (universal layout)
+    {save_dir}/{tag}/optimizer.npz     optimizer-state arrays
+    {save_dir}/latest                  text file holding the newest tag
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Any
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint import serialization as ser
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class CheckpointEngine:
+    """Synchronous array writer (reference ``TorchCheckpointEngine`` analog)."""
+
+    def save(self, state: dict[str, dict[str, np.ndarray]], ckpt_dir: str) -> None:
+        for name, arrays in state.items():
+            if name == "manifest":
+                ser.save_json(os.path.join(ckpt_dir, "manifest.json"), arrays)
+            else:
+                ser.save_arrays(os.path.join(ckpt_dir, f"{name}.npz"), arrays)
+
+    def load(self, ckpt_dir: str, names: list[str]) -> dict[str, Any]:
+        out = {"manifest": ser.load_json(os.path.join(ckpt_dir, "manifest.json"))}
+        for name in names:
+            path = os.path.join(ckpt_dir, f"{name}.npz")
+            if os.path.exists(path):
+                out[name] = ser.load_arrays(path)
+        return out
+
+    def commit(self, tag: str) -> bool:
+        return True
+
+    def wait(self) -> None:
+        pass
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Background-thread writer (reference ``decoupled_checkpoint_engine.py``:
+    rank writers off the training critical path). ``save`` snapshots arrays to
+    host (synchronous, cheap) and writes on a worker thread; ``wait`` joins."""
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+
+    def save(self, state, ckpt_dir: str) -> None:
+        self.wait()
+        self._thread = threading.Thread(
+            target=super().save, args=(state, ckpt_dir), daemon=True
+        )
+        self._thread.start()
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+
+def latest_tag(save_dir: str) -> str | None:
+    path = os.path.join(save_dir, "latest")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return f.read().strip()
+
+
+def write_latest(save_dir: str, tag: str) -> None:
+    with open(os.path.join(save_dir, "latest"), "w") as f:
+        f.write(tag)
+
+
+def rotate_checkpoints(save_dir: str, keep_n: int) -> None:
+    """Delete oldest tagged dirs beyond ``keep_n`` (0 = keep all)."""
+    if keep_n <= 0:
+        return
+    tags = [
+        d
+        for d in os.listdir(save_dir)
+        if os.path.isdir(os.path.join(save_dir, d)) and not d.startswith(".")
+    ]
+    tags.sort(key=lambda d: os.path.getmtime(os.path.join(save_dir, d)))
+    for d in tags[:-keep_n]:
+        shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
+        log_dist(f"rotated out checkpoint {d}", ranks=[0])
+
+
+def get_checkpoint_engine(async_save: bool) -> CheckpointEngine:
+    return AsyncCheckpointEngine() if async_save else CheckpointEngine()

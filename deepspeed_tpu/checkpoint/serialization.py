@@ -1,0 +1,74 @@
+"""Pytree <-> on-disk array serialization.
+
+The on-disk format is *universal by construction*: every leaf is saved as a
+FULL (unsharded) fp32/int array keyed by its pytree path. This is the
+reference's Universal Checkpoint end state (``checkpoint/ds_to_universal.py``:
+per-param fragments mergeable across world sizes) without the conversion step —
+loading re-places arrays under whatever sharding plan the *new* topology uses,
+so world-size / ZeRO-stage / TP-degree resharding is just save -> load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def tree_to_arrays(tree: Any) -> dict[str, np.ndarray]:
+    """Flatten a pytree into {path_key: full numpy array}. Sharded jax.Arrays
+    are gathered (they must be fully addressable or replicated per host)."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def arrays_to_tree(template: Any, arrays: dict[str, np.ndarray], strict: bool = True) -> Any:
+    """Rebuild a pytree congruent to ``template`` from saved arrays.
+
+    Leaves are matched by path key; shapes must agree (dtype follows the
+    template so e.g. a bf16 deployment can load fp32 masters).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        if key not in arrays:
+            if strict:
+                raise KeyError(f"checkpoint missing leaf {key}")
+            leaves.append(leaf)
+            continue
+        arr = arrays[key]
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(
+                f"checkpoint leaf {key} shape {arr.shape} != expected {leaf.shape}"
+            )
+        leaves.append(arr.astype(leaf.dtype) if hasattr(leaf, "dtype") else arr)
+    return jax.tree_util.tree_unflatten(treedef, [l for l in leaves])
+
+
+def save_arrays(path: str, arrays: dict[str, np.ndarray]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # npz member names may not contain '/' reliably across loaders; escape.
+    np.savez(path, **{k.replace("/", "\\slash "): v for k, v in arrays.items()})
+
+
+def load_arrays(path: str) -> dict[str, np.ndarray]:
+    with np.load(path, allow_pickle=False) as z:
+        return {k.replace("\\slash ", "/"): z[k] for k in z.files}
+
+
+def save_json(path: str, obj: dict) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=2, default=str)
+
+
+def load_json(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

@@ -405,6 +405,106 @@ class Engine:
                 ranks=[0],
             )
 
+    # ------------------------------------------------------------------ checkpoint
+    def save_checkpoint(self, save_dir: str, tag: str | None = None,
+                        client_state: dict | None = None, save_latest: bool = True):
+        """Reference ``engine.py:4557 save_checkpoint``: tagged dir + manifest +
+        full-array (universal-layout) model/optimizer files + ``latest``."""
+        import os
+
+        from deepspeed_tpu.checkpoint import engine as ckpt
+        from deepspeed_tpu.checkpoint import serialization as ser
+
+        if getattr(self, "_ckpt_engine", None) is None:
+            self._ckpt_engine = ckpt.get_checkpoint_engine(self.config.checkpoint.async_save)
+        tag = tag or f"global_step{self.global_steps}"
+        ckpt_dir = os.path.join(save_dir, str(tag))
+        manifest = {
+            "tag": tag,
+            "framework_version": __import__("deepspeed_tpu").__version__,
+            "model_name": self.model_spec.name,
+            "zero_stage": self.zero_stage,
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "loss_scale": float(self.scale_state.scale),
+            "lr_scheduler": self.lr_scheduler.state_dict(),
+            "world_size": self.topo.world_size,
+            "mesh": dict(self.topo.sizes),
+            "config": self.config.to_dict(),
+            "client_state": client_state or {},
+        }
+        state = {
+            "manifest": manifest,
+            "model": ser.tree_to_arrays(self.params),
+            "optimizer": {
+                **ser.tree_to_arrays(self.opt_state),
+                **{f"__scale__{k}": np.asarray(v)
+                   for k, v in self.scale_state._asdict().items()},
+            },
+        }
+        import jax as _jax
+
+        if _jax.process_index() == 0:
+            self._ckpt_engine.save(state, ckpt_dir)
+            self._ckpt_engine.wait() if not self.config.checkpoint.async_save else None
+            if save_latest:
+                ckpt.write_latest(save_dir, str(tag))
+            ckpt.rotate_checkpoints(save_dir, self.config.checkpoint.keep_n_latest)
+        dist.barrier("save_checkpoint")
+        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+        return ckpt_dir
+
+    def load_checkpoint(self, load_dir: str, tag: str | None = None,
+                        load_optimizer_states: bool = True,
+                        load_lr_scheduler_states: bool = True):
+        """Reference ``engine.py:4079 load_checkpoint``. Arrays are re-placed
+        under the *current* sharding plan, so loading across a different mesh /
+        ZeRO stage / world size is automatic (UCP semantics)."""
+        import os
+
+        from deepspeed_tpu.checkpoint import engine as ckpt
+        from deepspeed_tpu.checkpoint import serialization as ser
+
+        tag = tag or ckpt.latest_tag(load_dir)
+        if tag is None:
+            log_dist(f"no checkpoint found under {load_dir}", ranks=[0])
+            return None, {}
+        ckpt_dir = os.path.join(load_dir, str(tag))
+        engine_io = ckpt.CheckpointEngine()
+        names = ["model"] + (["optimizer"] if load_optimizer_states else [])
+        state = engine_io.load(ckpt_dir, names)
+        manifest = state["manifest"]
+
+        params_host = ser.arrays_to_tree(
+            jax.tree_util.tree_map(np.asarray, self.params), state["model"]
+        )
+        self.params = jax.device_put(params_host, self.plan.param_shardings)
+        if load_optimizer_states and "optimizer" in state:
+            opt_arrays = {k: v for k, v in state["optimizer"].items()
+                          if not k.startswith("__scale__")}
+            opt_host = ser.arrays_to_tree(
+                jax.tree_util.tree_map(np.asarray, self.opt_state), opt_arrays
+            )
+            self.opt_state = jax.device_put(opt_host, self._opt_shardings)
+            scale_kw = {k[len("__scale__"):]: jnp.asarray(v)
+                        for k, v in state["optimizer"].items() if k.startswith("__scale__")}
+            if scale_kw:
+                self.scale_state = LossScaleState(**scale_kw)
+        self.global_steps = int(manifest["global_steps"])
+        self.global_samples = int(manifest["global_samples"])
+        self.micro_steps = int(manifest["micro_steps"])
+        self.skipped_steps = int(manifest["skipped_steps"])
+        if load_lr_scheduler_states:
+            self.lr_scheduler.load_state_dict(manifest["lr_scheduler"])
+        log_dist(
+            f"loaded checkpoint {ckpt_dir} (saved at world_size="
+            f"{manifest['world_size']}, now {self.topo.world_size})",
+            ranks=[0],
+        )
+        return ckpt_dir, manifest.get("client_state", {})
+
     # ------------------------------------------------------------------ accessors
     @property
     def loss_scale(self) -> float:
