@@ -43,11 +43,36 @@ DEFAULT_ACTIVATION_RULES = {
 @dataclass
 class ShardCtx:
     """Carries the mesh + activation rules into model code for
-    ``with_sharding_constraint`` hints. A ``None`` mesh disables constraints
+    ``with_sharding_constraint`` hints, and dispatches attention through the
+    configured sequence-parallel mode. A ``None`` mesh disables constraints
     (single-device or tracing outside the engine)."""
 
     mesh: Any = None
     rules: dict = field(default_factory=lambda: dict(DEFAULT_ACTIVATION_RULES))
+    sp_mode: str = "ulysses"  # ulysses | ring (reference: deepspeed/sequence/)
+    attn_impl: str = "auto"
+
+    @property
+    def sp_degree(self) -> int:
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.shape.get("sequence", 1))
+
+    def attention(self, q, k, v, causal: bool = True, impl: str | None = None):
+        """Models call attention through here; with an active ``sequence`` axis
+        this routes to Ulysses all-to-all or ring/context-parallel attention."""
+        impl = impl or self.attn_impl
+        from deepspeed_tpu.ops.attention import attention as local_attention
+
+        if self.sp_degree <= 1:
+            return local_attention(q, k, v, causal=causal, impl=impl)
+        if self.sp_mode == "ring":
+            from deepspeed_tpu.parallel.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, self.mesh, causal=causal)
+        from deepspeed_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, self.mesh, causal=causal, impl=impl)
 
     def constrain(self, x: jnp.ndarray, *logical_dims: Optional[str]) -> jnp.ndarray:
         if self.mesh is None:

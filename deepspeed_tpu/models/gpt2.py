@@ -16,7 +16,6 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.models.api import ModelSpec, ShardCtx, causal_lm_loss
-from deepspeed_tpu.ops.attention import attention
 
 
 @dataclass(frozen=True)
@@ -104,7 +103,7 @@ def _block(cfg: GPT2Config, ctx: ShardCtx, attn_impl: str, x, lp):
     kk = (h @ lp["wk"] + lp["bk"]).reshape(b, s, cfg.num_heads, cfg.hd)
     vv = (h @ lp["wv"] + lp["bv"]).reshape(b, s, cfg.num_heads, cfg.hd)
     q = ctx.constrain(q, "batch", "seq", "heads_act", None)
-    o = attention(q, kk, vv, causal=True, impl=attn_impl).reshape(b, s, d)
+    o = ctx.attention(q, kk, vv, causal=True, impl=attn_impl).reshape(b, s, d)
     x = x + o @ lp["wo"] + lp["bo"]
     h = layernorm(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
     h = jax.nn.gelu(h @ lp["w_in"] + lp["b_in"], approximate=True)

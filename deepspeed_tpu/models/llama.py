@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.models.api import ModelSpec, ShardCtx, causal_lm_loss, count_params
-from deepspeed_tpu.ops.attention import apply_rope, attention
+from deepspeed_tpu.ops.attention import apply_rope
 
 
 @dataclass(frozen=True)
@@ -122,7 +122,7 @@ def _decoder_layer(cfg: LlamaConfig, ctx: ShardCtx, attn_impl: str,
     q = ctx.constrain(q, "batch", "seq", "heads_act", None)
     kk = ctx.constrain(kk, "batch", "seq", "heads_act", None)
     q, kk = apply_rope(q, kk, positions, cfg.rope_theta)
-    o = attention(q, kk, vv, causal=True, impl=attn_impl)
+    o = ctx.attention(q, kk, vv, causal=True, impl=attn_impl)
     x = x + o.reshape(b, s, hq * hd) @ lp["wo"]
     x = ctx.constrain(x, "batch", "seq", "embed_act")
 

@@ -1,0 +1,93 @@
+"""Ring attention: context parallelism over the sequence axis.
+
+The reference has NO ring/context-parallel implementation (SURVEY.md §5.7) —
+its long-context answer is Ulysses all-to-all plus chunked/offloaded attention
+(FPDT). On TPU, ring attention over an ICI ring is the idiomatic counterpart:
+KV shards rotate around the ``sequence`` axis with ``ppermute`` while each rank
+accumulates blockwise-softmax partial attention for its local queries — comm is
+fully overlappable with the block compute, and per-device memory stays
+O(S/P). Offered as ``sequence_parallel.mode = "ring"``.
+
+Implementation: ``shard_map`` over the sequence axis; fp32 online-softmax
+accumulation (same math as flash attention's outer loop, with the KV loop
+distributed). Causality is enforced by global-position masking, so the result
+is exact vs. single-device causal attention.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.topology import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
+from deepspeed_tpu.ops.attention import repeat_kv
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale):
+    """Runs inside shard_map: q/k/v are local seq shards [B, S_loc, H, D]."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qf = (q * scale).astype(jnp.float32)
+    q_pos = my * s_loc + jnp.arange(s_loc)  # global positions of local queries
+
+    # accumulator state: running max m, denom l, weighted sum o (all fp32)
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+
+    def step(carry, i):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        src = (my - i) % n  # which global KV block we currently hold
+        k_pos = src * s_loc + jnp.arange(s_loc)
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+
+        m_blk = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m_acc, m_blk)
+        # guard fully-masked rows (m_new == -inf): exp(_NEG_INF - _NEG_INF) -> use safe sub
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m_acc - m_new)
+        l_new = l_acc * corr + jnp.sum(p, axis=-1)
+        o_blk = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        o_new = o_acc * corr.transpose(0, 2, 1)[..., None] + o_blk
+
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, causal: bool = True, scale=None):
+    """[B, S, H, D] seq-sharded in/out; exact causal attention over the ring."""
+    sp = mesh.shape.get(AXIS_SEQ, 1)
+    if sp <= 1:
+        from deepspeed_tpu.ops.attention import xla_attention
+
+        return xla_attention(q, k, v, causal=causal, scale=scale)
+    k = repeat_kv(k, q.shape[2] // k.shape[2])
+    v = repeat_kv(v, q.shape[2] // v.shape[2])
+
+    b_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if mesh.shape.get(a, 1) > 1)
+    b_ax = b_axes if len(b_axes) > 1 else (b_axes[0] if b_axes else None)
+    spec = P(b_ax, AXIS_SEQ, None, None)
+    fn = functools.partial(_ring_attention_local, axis_name=AXIS_SEQ,
+                           causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

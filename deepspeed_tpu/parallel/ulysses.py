@@ -1,0 +1,72 @@
+"""Ulysses sequence parallelism: head<->sequence all-to-all around attention.
+
+Role parity with the reference ``deepspeed/sequence/layer.py``
+(``_SeqAllToAll:297``, ``DistributedAttention:351``): activations are sharded on
+the sequence dim; before attention an all-to-all converts seq-sharding to
+head-sharding (each rank sees the FULL sequence for a subset of heads), the
+local attention runs unchanged, and the inverse all-to-all restores
+seq-sharding.
+
+TPU-native expression: the all-to-alls are *sharding constraints* — GSPMD emits
+``all-to-all`` HLOs over the ``sequence`` ICI axis when an array's sharding
+moves from the seq dim to the head dim. No manual collective plumbing, and the
+compiler overlaps them with adjacent compute. ``head-granularity`` note: the
+head dim must divide by the SP degree (reference uneven-head support
+``layer.py:131`` is handled by falling back to gathered attention).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.comm.topology import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
+from deepspeed_tpu.ops.attention import attention as _local_attention
+
+
+def _batch_axes(mesh):
+    axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if mesh.shape.get(a, 1) > 1)
+    if not axes:
+        return None
+    return axes if len(axes) > 1 else axes[0]
+
+
+def _constrain(mesh, x, spec):
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def ulysses_attention(q, k, v, mesh, causal: bool = True, impl: str = "auto",
+                      scale=None):
+    """[B, S, H, D] q/k/v seq-sharded in, seq-sharded out; attention computed
+    head-sharded over the full sequence (reference ``DistributedAttention``)."""
+    sp = mesh.shape.get(AXIS_SEQ, 1)
+    if sp <= 1:
+        return _local_attention(q, k, v, causal=causal, impl=impl, scale=scale)
+    b_ax = _batch_axes(mesh)
+
+    def head_spec(x):
+        # uneven heads (reference layer.py:131): a head dim not divisible by the
+        # SP degree falls back to replicated heads (sequence still gathered).
+        h_ax = AXIS_SEQ if x.shape[2] % sp == 0 else None
+        return PartitionSpec(b_ax, None, h_ax, None)
+
+    seq_spec = PartitionSpec(b_ax, AXIS_SEQ, None, None)
+
+    # seq->head all-to-all (GSPMD lowers the resharding to all-to-all on ICI)
+    q = _constrain(mesh, q, head_spec(q))
+    k = _constrain(mesh, k, head_spec(k))
+    v = _constrain(mesh, v, head_spec(v))
+    out = _local_attention(q, k, v, causal=causal, impl=impl, scale=scale)
+    # head->seq inverse all-to-all
+    return _constrain(mesh, out, seq_spec)
+
+
+def shard_batch_on_sequence(batch: dict, mesh) -> dict:
+    """Reference ``UlyssesSPDataLoaderAdapter`` (``runtime/sequence_parallel/
+    ulysses_sp.py:564``): incoming [B, S] batches are sharded on the seq dim."""
+    b_ax = _batch_axes(mesh)
+    out = {}
+    for key, val in batch.items():
+        spec = PartitionSpec(b_ax, AXIS_SEQ) if val.ndim >= 2 else PartitionSpec(b_ax)
+        out[key] = jax.device_put(val, NamedSharding(mesh, spec))
+    return out

@@ -91,7 +91,7 @@ class Engine:
     ):
         self.config = config
         self.topo = topo
-        self.shard_ctx = ShardCtx(mesh=topo.mesh)
+        self.shard_ctx = ShardCtx(mesh=topo.mesh, sp_mode=config.sequence_parallel.mode)
         self.model_spec = model(self.shard_ctx) if callable(model) else model
         self.training_dataloader = training_data
 
