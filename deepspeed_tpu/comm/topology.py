@@ -35,8 +35,24 @@ AXIS_EXPERT = "expert"
 AXIS_PIPE = "pipeline"
 ALL_AXES = (AXIS_PIPE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
 # Axes whose ranks consume distinct batch elements (the "DP world" of the batch
-# triangle). sequence splits within a batch element, tensor/pipeline replicate it.
-BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+# triangle). sequence splits within a batch element, tensor/pipeline replicate
+# it. expert is included: EP groups live inside the DP world (reference
+# ``utils/groups.py:304 _create_expert_and_data_parallel``), so expert ranks
+# consume distinct batch shards and exchange tokens at MoE layers.
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
+
+
+def batch_partition_axes(mesh) -> tuple:
+    """Active batch axes of a live Mesh (size > 1), for PartitionSpecs."""
+    return tuple(a for a in BATCH_AXES if mesh.shape.get(a, 1) > 1)
+
+
+def batch_spec_entry(mesh):
+    """The dim-0 entry of a batch PartitionSpec for this mesh."""
+    axes = batch_partition_axes(mesh)
+    if not axes:
+        return None
+    return axes if len(axes) > 1 else axes[0]
 
 
 @dataclass

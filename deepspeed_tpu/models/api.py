@@ -31,12 +31,13 @@ import jax.numpy as jnp
 
 # Mesh-axis mapping for activation sharding constraints (GSPMD hints).
 DEFAULT_ACTIVATION_RULES = {
-    "batch": ("data", "fsdp"),
+    "batch": ("data", "fsdp", "expert"),
     "seq": "sequence",
     "embed_act": None,
     "heads_act": "tensor",
     "ffn_act": "tensor",
     "vocab_act": "tensor",
+    "experts_act": "expert",
 }
 
 

@@ -1,0 +1,205 @@
+"""Mixtral-family sparse-MoE causal LM (BASELINE.json EP config: Mixtral-8x7B).
+
+Llama backbone (RMSNorm / RoPE / GQA) with a top-k routed SwiGLU expert FFN in
+every layer (reference analog: ``deepspeed/moe/layer.py MoE`` wrapping an HF
+model; v2 inference ``model_implementations/mixtral``). Expert weights are
+stacked ``[L, E, ...]`` so the expert GEMMs batch on the MXU and the expert dim
+shards over the ``expert`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.config.config import MoEConfig
+from deepspeed_tpu.models.api import ModelSpec, ShardCtx, causal_lm_loss
+from deepspeed_tpu.models.llama import rmsnorm
+from deepspeed_tpu.ops.attention import apply_rope
+from deepspeed_tpu.parallel.moe import moe_ffn
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    num_experts: int = 8
+    top_k: int = 2
+    head_dim: int | None = None
+    rope_theta: float = 1000000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    capacity_factor: float = 2.0
+    aux_loss_coef: float = 0.01
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    def moe_config(self) -> MoEConfig:
+        return MoEConfig(enabled=True, num_experts=self.num_experts, top_k=self.top_k,
+                         capacity_factor=self.capacity_factor,
+                         aux_loss_coef=self.aux_loss_coef)
+
+    @staticmethod
+    def mixtral_8x7b() -> "MixtralConfig":
+        return MixtralConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "MixtralConfig":
+        return MixtralConfig(vocab_size=vocab_size, hidden_size=64, intermediate_size=96,
+                             num_layers=2, num_heads=4, num_kv_heads=2, num_experts=4,
+                             top_k=2, max_seq_len=128)
+
+
+def init_params(cfg: MixtralConfig, rng) -> dict:
+    d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.hd
+    hq, hkv, nl, e = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers, cfg.num_experts
+    k = iter(jax.random.split(rng, 16))
+    std = 0.02
+    out_std = std / jnp.sqrt(2.0 * nl)
+
+    def norm(key, *shape, s=std):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    return {
+        "embed": norm(next(k), cfg.vocab_size, d),
+        "layers": {
+            "attn_norm": jnp.ones((nl, d), jnp.float32),
+            "wq": norm(next(k), nl, d, hq * hd),
+            "wk": norm(next(k), nl, d, hkv * hd),
+            "wv": norm(next(k), nl, d, hkv * hd),
+            "wo": norm(next(k), nl, hq * hd, d, s=out_std),
+            "mlp_norm": jnp.ones((nl, d), jnp.float32),
+            "router": norm(next(k), nl, d, e),
+            "w_gate": norm(next(k), nl, e, d, f),
+            "w_up": norm(next(k), nl, e, d, f),
+            "w_down": norm(next(k), nl, e, f, d, s=out_std),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": norm(next(k), d, cfg.vocab_size),
+    }
+
+
+PARAM_LOGICAL_AXES = {
+    "embed": ("vocab", "embed"),
+    "layers": {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "router": ("layers", "embed", None),
+        "w_gate": ("layers", "experts", "embed", "ffn"),
+        "w_up": ("layers", "experts", "embed", "ffn"),
+        "w_down": ("layers", "experts", "ffn", "embed"),
+    },
+    "final_norm": ("embed",),
+    "lm_head": ("embed", "vocab"),
+}
+
+
+def _layer(cfg: MixtralConfig, moe_cfg: MoEConfig, ctx: ShardCtx, attn_impl: str,
+           train: bool, x, lp, positions, rng):
+    b, s, d = x.shape
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, hq, hd)
+    kk = (h @ lp["wk"]).reshape(b, s, hkv, hd)
+    vv = (h @ lp["wv"]).reshape(b, s, hkv, hd)
+    q = ctx.constrain(q, "batch", "seq", "heads_act", None)
+    q, kk = apply_rope(q, kk, positions, cfg.rope_theta)
+    o = ctx.attention(q, kk, vv, causal=True, impl=attn_impl)
+    x = x + o.reshape(b, s, hq * hd) @ lp["wo"]
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    y, aux = moe_ffn(h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+                     moe_cfg, train=train, rng=rng, ctx=ctx)
+    x = x + y
+    return ctx.constrain(x, "batch", "seq", "embed_act"), aux
+
+
+def forward(cfg: MixtralConfig, params, input_ids, ctx: ShardCtx | None = None,
+            attn_impl: str = "auto", train: bool = True, rng=None,
+            remat: bool = False, remat_policy=None, return_aux: bool = False):
+    ctx = ctx or ShardCtx()
+    moe_cfg = cfg.moe_config()
+    b, s = input_ids.shape
+    x = params["embed"][input_ids]
+    x = ctx.constrain(x, "batch", "seq", "embed_act")
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    layer = partial(_layer, cfg, moe_cfg, ctx, attn_impl, train)
+    if remat:
+        layer = jax.checkpoint(layer, policy=remat_policy)
+
+    def body(carry, lp_idx):
+        x, aux_sum = carry
+        lp, idx = lp_idx
+        x, aux = layer(x, lp, positions, jax.random.fold_in(rng, idx))
+        return (x, aux_sum + aux), None
+
+    (x, aux_sum), _ = lax.scan(
+        body, (x, jnp.float32(0.0)),
+        (params["layers"], jnp.arange(cfg.num_layers)),
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = x @ params["lm_head"].astype(x.dtype)
+    logits = ctx.constrain(logits, "batch", "seq", "vocab_act")
+    if return_aux:
+        return logits, aux_sum / cfg.num_layers
+    return logits
+
+
+def num_params(cfg: MixtralConfig) -> int:
+    d, f, hd, e = cfg.hidden_size, cfg.intermediate_size, cfg.hd, cfg.num_experts
+    per_layer = (d * hd * (cfg.num_heads * 2 + cfg.num_kv_heads * 2) + d * e
+                 + 3 * e * d * f + 2 * d)
+    return cfg.vocab_size * d * 2 + cfg.num_layers * per_layer + d
+
+
+def flops_per_token(cfg: MixtralConfig, seq_len: int) -> float:
+    """Active-param flops: attention + top_k of E experts."""
+    d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.hd
+    active_per_layer = (d * hd * (cfg.num_heads * 2 + cfg.num_kv_heads * 2)
+                        + cfg.top_k * 3 * d * f + d * cfg.num_experts)
+    active = cfg.vocab_size * d * 2 + cfg.num_layers * active_per_layer
+    return 6.0 * active + 12.0 * cfg.num_layers * d * seq_len / 2.0
+
+
+def build(cfg: MixtralConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto",
+          remat: bool = False, remat_policy=None) -> ModelSpec:
+    ctx = ctx or ShardCtx()
+    fwd = partial(forward, cfg, ctx=ctx, attn_impl=attn_impl,
+                  remat=remat, remat_policy=remat_policy, train=False)
+
+    def loss_fn(params, batch, rng=None):
+        logits, aux = forward(cfg, params, batch["input_ids"], ctx=ctx,
+                              attn_impl=attn_impl, train=True, rng=rng,
+                              remat=remat, remat_policy=remat_policy, return_aux=True)
+        lm = causal_lm_loss(logits, batch["input_ids"], batch.get("labels"))
+        return lm + cfg.aux_loss_coef * aux
+
+    return ModelSpec(
+        name="mixtral",
+        config=cfg,
+        init_fn=partial(init_params, cfg),
+        loss_fn=loss_fn,
+        forward_fn=fwd,
+        param_logical_axes=PARAM_LOGICAL_AXES,
+        logical_dim_units={"heads": cfg.num_heads, "kv_heads": cfg.num_kv_heads,
+                           "experts": cfg.num_experts},
+        num_params=num_params(cfg),
+        flops_per_token=partial(flops_per_token, cfg),
+    )

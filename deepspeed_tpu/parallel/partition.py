@@ -74,10 +74,10 @@ def _spec_for_param(
             if shape[i] % topo.size(AXIS_PIPE) == 0:
                 assign[i] = AXIS_PIPE
             continue
-        if not use_tp:
-            continue
         mesh_axis = TP_LOGICAL_TO_MESH.get(logical)
         if mesh_axis is None:
+            continue
+        if mesh_axis == AXIS_TENSOR and not use_tp:
             continue
         n = topo.size(mesh_axis)
         if n <= 1 or shape[i] % n != 0:
@@ -181,12 +181,10 @@ def plan_sharding(
     param_specs = shard_specs if zero_stage >= 3 else build(shard_fsdp=False)
     grad_specs = shard_specs if zero_stage >= 2 else param_specs
 
-    batch_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if topo.size(a) > 1)
+    from deepspeed_tpu.comm.topology import batch_spec_entry
+
     seq_axis = AXIS_SEQ if topo.size(AXIS_SEQ) > 1 else None
-    batch_spec = PartitionSpec(
-        batch_axes if len(batch_axes) > 1 else (batch_axes[0] if batch_axes else None),
-        seq_axis,
-    )
+    batch_spec = PartitionSpec(batch_spec_entry(topo.mesh), seq_axis)
     return ShardingPlan(
         topo=topo,
         param_specs=param_specs,

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.comm.topology import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
+from deepspeed_tpu.comm.topology import AXIS_SEQ, batch_spec_entry
 from deepspeed_tpu.ops.attention import repeat_kv
 
 _NEG_INF = -1e30
@@ -84,9 +84,7 @@ def ring_attention(q, k, v, mesh, causal: bool = True, scale=None):
     k = repeat_kv(k, q.shape[2] // k.shape[2])
     v = repeat_kv(v, q.shape[2] // v.shape[2])
 
-    b_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if mesh.shape.get(a, 1) > 1)
-    b_ax = b_axes if len(b_axes) > 1 else (b_axes[0] if b_axes else None)
-    spec = P(b_ax, AXIS_SEQ, None, None)
+    spec = P(batch_spec_entry(mesh), AXIS_SEQ, None, None)
     fn = functools.partial(_ring_attention_local, axis_name=AXIS_SEQ,
                            causal=causal, scale=scale)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

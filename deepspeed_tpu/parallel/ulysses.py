@@ -20,15 +20,12 @@ from __future__ import annotations
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
-from deepspeed_tpu.comm.topology import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
+from deepspeed_tpu.comm.topology import AXIS_SEQ, batch_spec_entry
 from deepspeed_tpu.ops.attention import attention as _local_attention
 
 
 def _batch_axes(mesh):
-    axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if mesh.shape.get(a, 1) > 1)
-    if not axes:
-        return None
-    return axes if len(axes) > 1 else axes[0]
+    return batch_spec_entry(mesh)
 
 
 def _constrain(mesh, x, spec):
