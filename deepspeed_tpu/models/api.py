@@ -52,12 +52,37 @@ class ShardCtx:
     rules: dict = field(default_factory=lambda: dict(DEFAULT_ACTIVATION_RULES))
     sp_mode: str = "ulysses"  # ulysses | ring (reference: deepspeed/sequence/)
     attn_impl: str = "auto"
+    pp_microbatches: int = 0  # 0 -> pipeline degree
 
     @property
     def sp_degree(self) -> int:
         if self.mesh is None:
             return 1
         return int(self.mesh.shape.get("sequence", 1))
+
+    @property
+    def pp_degree(self) -> int:
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.shape.get("pipeline", 1))
+
+    def layer_stack(self, layer_fn, stacked_params, x):
+        """Run the decoder stack: plain ``lax.scan`` normally, the collective
+        microbatch pipeline when the ``pipeline`` mesh axis is active."""
+        import jax.lax as lax
+
+        if self.pp_degree <= 1:
+            return lax.scan(lambda c, lp: (layer_fn(c, lp), None), x, stacked_params)[0]
+        from deepspeed_tpu.parallel.pipeline import pipeline_apply
+
+        # sharding hints inside the manual-over-pipeline region are suspended;
+        # GSPMD still propagates layouts for the auto axes from the inputs
+        self._suspend_constraints = True
+        try:
+            return pipeline_apply(layer_fn, stacked_params, x, self.mesh,
+                                  num_microbatches=self.pp_microbatches)
+        finally:
+            self._suspend_constraints = False
 
     def attention(self, q, k, v, causal: bool = True, impl: str | None = None):
         """Models call attention through here; with an active ``sequence`` axis
@@ -76,7 +101,7 @@ class ShardCtx:
         return ulysses_attention(q, k, v, self.mesh, causal=causal, impl=impl)
 
     def constrain(self, x: jnp.ndarray, *logical_dims: Optional[str]) -> jnp.ndarray:
-        if self.mesh is None:
+        if self.mesh is None or getattr(self, "_suspend_constraints", False):
             return x
         spec = []
         for dim in logical_dims:

@@ -122,7 +122,7 @@ def forward(cfg: GPT2Config, params, input_ids, ctx: ShardCtx | None = None,
     layer = partial(_block, cfg, ctx, attn_impl)
     if remat:
         layer = jax.checkpoint(layer, policy=remat_policy)
-    x, _ = lax.scan(lambda c, lp: (layer(c, lp), None), x, params["layers"])
+    x = ctx.layer_stack(layer, params["layers"], x)
     x = layernorm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
     logits = x @ params["wte"].T.astype(x.dtype)  # tied head
     return ctx.constrain(logits, "batch", "seq", "vocab_act")

@@ -111,9 +111,11 @@ def rmsnorm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 
 def _decoder_layer(cfg: LlamaConfig, ctx: ShardCtx, attn_impl: str,
-                   x: jnp.ndarray, lp: dict, positions: jnp.ndarray) -> jnp.ndarray:
+                   x: jnp.ndarray, lp: dict, positions: jnp.ndarray | None = None) -> jnp.ndarray:
     b, s, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
 
     h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
     q = (h @ lp["wq"]).reshape(b, s, hq, hd)
@@ -140,18 +142,14 @@ def forward(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
     """[B, S] int tokens -> [B, S, V] logits. Decoder is a scan over the layer stack."""
     ctx = ctx or ShardCtx()
     b, s = input_ids.shape
-    x = params["embed"].astype(params["embed"].dtype)[input_ids]
+    x = params["embed"][input_ids]
     x = ctx.constrain(x, "batch", "seq", "embed_act")
-    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
 
     layer = partial(_decoder_layer, cfg, ctx, attn_impl)
     if remat:
         layer = jax.checkpoint(layer, policy=remat_policy)
 
-    def body(carry, lp):
-        return layer(carry, lp, positions), None
-
-    x, _ = lax.scan(body, x, params["layers"])
+    x = ctx.layer_stack(layer, params["layers"], x)
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = x @ head.astype(x.dtype)

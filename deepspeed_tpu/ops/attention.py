@@ -81,7 +81,7 @@ def attention(
         try:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=causal, scale=scale)
+            return flash_attention(q, k, v, causal, scale)
         except (ImportError, NotImplementedError):
             impl = "xla"
     if impl == "xla":

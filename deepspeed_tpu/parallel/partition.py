@@ -66,13 +66,19 @@ def _spec_for_param(
     size = 1
     for s in shape:
         size *= s
+    # Pipelined layer stacks shard ONLY on the pipeline axis: within a stage the
+    # layer weights must be whole (the stage body runs as manual SPMD), so
+    # TP/fsdp are not applied to them — mirroring the reference's PP (x)
+    # ZeRO<=1 composition constraint (runtime/pipe + zero stage checks).
+    if topo.size(AXIS_PIPE) > 1 and "layers" in axes:
+        i = axes.index("layers")
+        if shape[i] % topo.size(AXIS_PIPE) == 0:
+            assign[i] = AXIS_PIPE
+        return PartitionSpec(*assign)
     for i, logical in enumerate(axes):
         if logical is None:
             continue
-        if logical == "layers" and topo.size(AXIS_PIPE) > 1:
-            # stacked-layer dim belongs to the pipeline axis when PP is active
-            if shape[i] % topo.size(AXIS_PIPE) == 0:
-                assign[i] = AXIS_PIPE
+        if logical == "layers":
             continue
         mesh_axis = TP_LOGICAL_TO_MESH.get(logical)
         if mesh_axis is None:

@@ -84,8 +84,9 @@ def ring_attention(q, k, v, mesh, causal: bool = True, scale=None):
     k = repeat_kv(k, q.shape[2] // k.shape[2])
     v = repeat_kv(v, q.shape[2] // v.shape[2])
 
-    spec = P(batch_spec_entry(mesh), AXIS_SEQ, None, None)
+    spec = P(None, AXIS_SEQ, None, None)
     fn = functools.partial(_ring_attention_local, axis_name=AXIS_SEQ,
                            causal=causal, scale=scale)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+                         out_specs=spec, axis_names={AXIS_SEQ},
+                         check_vma=False)(q, k, v)

@@ -91,7 +91,11 @@ class Engine:
     ):
         self.config = config
         self.topo = topo
-        self.shard_ctx = ShardCtx(mesh=topo.mesh, sp_mode=config.sequence_parallel.mode)
+        self.shard_ctx = ShardCtx(
+            mesh=topo.mesh,
+            sp_mode=config.sequence_parallel.mode,
+            pp_microbatches=config.pipeline.num_microbatches,
+        )
         self.model_spec = model(self.shard_ctx) if callable(model) else model
         self.training_dataloader = training_data
 
