@@ -43,16 +43,16 @@ def main():
     # Sized to fit one chip's HBM with fp32 master + Adam moments (~18 B/param).
     model_cfg = llama.LlamaConfig(
         vocab_size=int(os.environ.get("BENCH_VOCAB", 32000)),
-        hidden_size=int(os.environ.get("BENCH_HIDDEN", 1024)),
-        intermediate_size=int(os.environ.get("BENCH_FFN", 2816)),
-        num_layers=int(os.environ.get("BENCH_LAYERS", 12)),
+        hidden_size=int(os.environ.get("BENCH_HIDDEN", 2048)),
+        intermediate_size=int(os.environ.get("BENCH_FFN", 5632)),
+        num_layers=int(os.environ.get("BENCH_LAYERS", 10)),
         num_heads=16,
         num_kv_heads=8,
         max_seq_len=2048,
     ) if on_tpu else llama.LlamaConfig.tiny(512)
 
     seq = int(os.environ.get("BENCH_SEQ", 2048)) if on_tpu else 64
-    batch = int(os.environ.get("BENCH_BATCH", 8)) if on_tpu else 4
+    batch = int(os.environ.get("BENCH_BATCH", 16)) if on_tpu else 4
     steps = int(os.environ.get("BENCH_STEPS", 10)) if on_tpu else 3
 
     config = {

@@ -10,14 +10,17 @@ execution (``pl.when``), halving the work.
 
 Layouts: q/k/v [B, S, H, D] (GQA supported: the K/V block index maps divide the
 head index, so KV heads are never replicated in memory). The backward pass is
-a saved-lse XLA recomputation (standard flash backward algebra) — a dedicated
-Pallas backward kernel is a follow-up optimization.
+two Pallas kernels (dk/dv accumulated over q blocks; dq accumulated over kv
+blocks) from the saved lse — the [Sq, Sk] score matrix never materializes in
+either direction. Set ``DSTPU_FLASH_XLA_BWD=1`` to fall back to the XLA
+recompute backward.
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -164,7 +167,155 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, scale, block_q, block_k, res, do):
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                 dk_acc, dv_acc, *, scale: float, causal: bool,
+                 block_q: int, block_k: int):
+    j = pl.program_id(2)  # kv block
+    i = pl.program_id(3)  # q block (sequential innermost)
+    ni = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = (i + 1) * block_q - 1 >= j * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                  # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)                  # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)                # [bq, d]
+        lse = lse_ref[0, 0]                                  # [bq, 1]
+        delta = delta_ref[0, 0]                              # [bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                  # [bq, bk]
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(i == ni - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+               *, scale: float, causal: bool, block_q: int, block_k: int):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # kv block (sequential innermost)
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = (i + 1) * block_q - 1 >= j * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, block_q, block_k):
+    b, sq, hq, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    n_rep = hq // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = delta.transpose(0, 2, 1)[..., None]               # [B, Hq, Sq, 1]
+    lse4 = lse[..., None]                                     # [B, Hq, Sq, 1]
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = do.transpose(0, 2, 1, 3)
+
+    q_spec_i = pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i, j: (b_, h, i, 0))
+    q_spec_j = pl.BlockSpec((1, 1, block_q, d), lambda b_, h, j, i: (b_, h, i, 0))
+    kv_spec_i = pl.BlockSpec((1, 1, block_k, d), lambda b_, h, i, j: (b_, h // n_rep, j, 0))
+    kv_spec_j = pl.BlockSpec((1, 1, block_k, d), lambda b_, h, j, i: (b_, h // n_rep, j, 0))
+    row_spec_i = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h, i, j: (b_, h, i, 0))
+    row_spec_j = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h, j, i: (b_, h, i, 0))
+
+    # dk/dv: one [B, Hq, Skv, D] buffer per q-head group, reduced below for GQA
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_dkdv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hq, skv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, skv, d), jnp.float32),
+        ),
+        grid=(b, hq, skv // block_k, sq // block_q),
+        in_specs=[q_spec_j, kv_spec_j, kv_spec_j, q_spec_j, row_spec_j, row_spec_j],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h, j, i: (b_, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h, j, i: (b_, h, j, 0)),
+        ),
+        scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
+        interpret=_interpret_mode(),
+    )(qt, kt, vt, dot, lse4, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        grid=(b, hq, sq // block_q, skv // block_k),
+        in_specs=[q_spec_i, kv_spec_i, kv_spec_i, q_spec_i, row_spec_i, row_spec_i],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i, j: (b_, h, i, 0)),
+        scratch_shapes=[_scratch((block_q, d))],
+        interpret=_interpret_mode(),
+    )(qt, kt, vt, dot, lse4, delta)
+
+    dq = dq.transpose(0, 2, 1, 3)
+    dk = dk_h.transpose(0, 2, 1, 3)
+    dv = dv_h.transpose(0, 2, 1, 3)
+    if n_rep > 1:
+        dk = dk.reshape(b, skv, hkv, n_rep, d).sum(axis=3)
+        dv = dv.reshape(b, skv, hkv, n_rep, d).sum(axis=3)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _fa_bwd_xla(causal, scale, block_q, block_k, res, do):
     """Standard flash backward algebra from saved lse (XLA; fp32)."""
     q, k, v, out, lse = res
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
@@ -195,6 +346,14 @@ def _fa_bwd(causal, scale, block_q, block_k, res, do):
         dk_full = dk_full.reshape(bsz, sk_, hkv, n_rep, dh).sum(axis=3)
         dv = dv.reshape(bsz, sk_, hkv, n_rep, dh).sum(axis=3)
     return dq, dk_full.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, res, do):
+    if os.environ.get("DSTPU_FLASH_XLA_BWD"):
+        return _fa_bwd_xla(causal, scale, block_q, block_k, res, do)
+    q, k, v, out, lse = res
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_bwd_pallas(q, k, v, out, lse, do, scale, causal, block_q, block_k)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
