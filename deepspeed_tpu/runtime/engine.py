@@ -124,6 +124,23 @@ class Engine:
         self.lr_schedule = build_schedule(config.scheduler, self._base_lr)
         self.optimizer = build_optimizer(config.optimizer, learning_rate=1.0)
         self._opt_shardings = opt_state_shardings(self.optimizer, self.params, self.plan)
+
+        # ZeRO-Offload: pin optimizer state in host DRAM (reference: zero
+        # cpu-offload + cpu_adam; here the state streams to HBM inside the step)
+        from deepspeed_tpu.runtime import offload as offload_mod
+
+        self._offload_opt = False
+        if zero.offload_optimizer.device in ("cpu", "nvme"):
+            if offload_mod.supports_memory_kinds():
+                self._offload_opt = True
+                self._opt_shardings_device = self._opt_shardings
+                self._opt_shardings = offload_mod.offload_shardings(self._opt_shardings)
+                log_dist("optimizer state offloaded to pinned host memory", ranks=[0])
+            else:
+                log_dist(
+                    "offload_optimizer requested but this backend has no host "
+                    "memory tier; keeping state on device", ranks=[0],
+                )
         self.opt_state = jax.jit(
             self.optimizer.init, out_shardings=self._opt_shardings
         )(self.params)
@@ -205,11 +222,19 @@ class Engine:
             coef = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
             grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
         lr = self.lr_schedule(step)
+        if self._offload_opt:
+            from deepspeed_tpu.runtime import offload as offload_mod
+
+            opt_state = offload_mod.stream_in(opt_state, self._opt_shardings_device)
         updates, new_opt = self.optimizer.update(grads, opt_state, params)
         updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
         new_params = optax.apply_updates(params, updates)
         new_params = _tree_select(finite, new_params, params)
         new_opt = _tree_select(finite, new_opt, opt_state)
+        if self._offload_opt:
+            from deepspeed_tpu.runtime import offload as offload_mod
+
+            new_opt = offload_mod.stream_out(new_opt, self._opt_shardings)
         new_scale = precision.update_loss_scale(scale_state, finite, cfg.fp16)
         metrics = {
             "grad_norm": gnorm,
