@@ -139,6 +139,10 @@ class ModelSpec:
     # analytics for MFU / flops profiler
     num_params: int = 0
     flops_per_token: Callable[[int], float] | None = None
+    # inference hooks: init_cache_fn(batch, max_len, dtype) -> cache;
+    # decode_fn(params, tokens, cache, start_pos) -> (logits, cache)
+    init_cache_fn: Callable | None = None
+    decode_fn: Callable | None = None
 
 
 def causal_lm_loss(

@@ -156,6 +156,70 @@ def forward(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
     return ctx.constrain(logits, "batch", "seq", "vocab_act")
 
 
+# ------------------------------------------------------------------ inference
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> dict:
+    """Per-layer KV cache, stacked [L, B, max_len, Hkv, Dh] (the TPU analog of
+    the reference inference KV workspace, ``inference/v2/ragged/kv_cache.py``
+    — blocked/paged variant lives in ``inference/kv_cache.py``)."""
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_layer(cfg: LlamaConfig, ctx: ShardCtx, x, lp, k_cache, v_cache,
+                  start_pos, max_len: int):
+    """Decode/prefill layer: append new KV at ``start_pos``, attend over the
+    cache prefix with absolute-position causal masking."""
+    b, t, d = x.shape
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(b, t, hq, hd)
+    kk = (h @ lp["wk"]).reshape(b, t, hkv, hd)
+    vv = (h @ lp["wv"]).reshape(b, t, hkv, hd)
+    positions = start_pos + jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    q, kk = apply_rope(q, kk, positions, cfg.rope_theta)
+
+    k_cache = lax.dynamic_update_slice(k_cache, kk.astype(k_cache.dtype), (0, start_pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, vv.astype(v_cache.dtype), (0, start_pos, 0, 0))
+
+    # mask: key visible iff its absolute position <= query's absolute position
+    q_pos = start_pos + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(max_len)[None, :]
+    bias = jnp.where(k_pos <= q_pos, 0.0, -1e30)[None, None]   # [1,1,t,max_len]
+    from deepspeed_tpu.ops.attention import xla_attention
+
+    o = xla_attention(q, k_cache, v_cache, causal=False, bias=bias)
+    x = x + o.reshape(b, t, hq * hd) @ lp["wo"]
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+    return x, k_cache, v_cache
+
+
+def decode_forward(cfg: LlamaConfig, params, tokens, cache, start_pos,
+                   ctx: ShardCtx | None = None):
+    """[B, T] new tokens + cache -> ([B, T, V] logits, updated cache).
+
+    Works for both prefill (T = prompt length, start_pos = 0) and incremental
+    decode (T = 1). Scans over the stacked layers, carrying x and threading the
+    per-layer cache through scan xs/ys.
+    """
+    ctx = ctx or ShardCtx()
+    max_len = cache["k"].shape[2]
+    x = params["embed"][tokens].astype(cache["k"].dtype)
+
+    def body(x, lp_kv):
+        lp, kc, vc = lp_kv
+        x, kc, vc = _cached_layer(cfg, ctx, x, lp, kc, vc, start_pos, max_len)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = x @ head.astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def num_params(cfg: LlamaConfig) -> int:
     d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.hd
     per_layer = d * hd * (cfg.num_heads * 2 + cfg.num_kv_heads * 2) + 3 * d * f + 2 * d
@@ -195,4 +259,6 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         logical_dim_units={"heads": cfg.num_heads, "kv_heads": cfg.num_kv_heads},
         num_params=num_params(cfg),
         flops_per_token=partial(flops_per_token, cfg),
+        init_cache_fn=partial(init_cache, cfg),
+        decode_fn=partial(decode_forward, cfg, ctx=ctx),
     )
