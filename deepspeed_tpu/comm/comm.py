@@ -50,6 +50,11 @@ def init_distributed(
     import jax
 
     if coordinator_address or os.environ.get("DSTPU_COORDINATOR"):
+        # launcher-provided rendezvous env (launcher/runner.py build_node_cmd)
+        if num_processes is None and os.environ.get("DSTPU_NUM_PROCESSES"):
+            num_processes = int(os.environ["DSTPU_NUM_PROCESSES"])
+        if process_id is None and os.environ.get("DSTPU_PROCESS_ID"):
+            process_id = int(os.environ["DSTPU_PROCESS_ID"])
         jax.distributed.initialize(
             coordinator_address=coordinator_address or os.environ.get("DSTPU_COORDINATOR"),
             num_processes=num_processes,

@@ -1,0 +1,132 @@
+"""Experiment monitoring: rank-0-gated fan-out to TensorBoard / CSV / W&B.
+
+Role parity with the reference ``monitor/monitor.py:13,30`` (``Monitor`` ABC +
+``MonitorMaster`` multiplexing ``TensorBoardMonitor``/``WandbMonitor``/
+``csvMonitor``; Comet omitted — its SDK isn't in the image and the writer
+protocol is identical). The event format matches the reference:
+``write_events([(tag, value, global_step), ...])``.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any
+
+from deepspeed_tpu.config.config import MonitorConfig
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _is_rank0() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class Monitor:
+    """Writer protocol (reference ``monitor/monitor.py:13``)."""
+
+    enabled = False
+
+    def write_events(self, event_list: list[tuple[str, Any, int]]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, cfg: dict):
+        self.enabled = False
+        if not _is_rank0():
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception:
+            log_dist("tensorboard writer unavailable; disabling", ranks=[0])
+            return
+        path = os.path.join(cfg.get("output_path", "./runs"), cfg.get("job_name", "dstpu"))
+        self.writer = SummaryWriter(log_dir=path)
+        self.enabled = True
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self.writer.add_scalar(tag, float(value), int(step))
+
+    def flush(self):
+        if self.enabled:
+            self.writer.flush()
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, cfg: dict):
+        self.enabled = False
+        if not _is_rank0():
+            return
+        self.dir = os.path.join(cfg.get("output_path", "./csv_logs"),
+                                cfg.get("job_name", "dstpu"))
+        os.makedirs(self.dir, exist_ok=True)
+        self._files: dict[str, Any] = {}
+        self.enabled = True
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            fname = os.path.join(self.dir, tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([int(step), float(value)])
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, cfg: dict):
+        self.enabled = False
+        if not _is_rank0():
+            return
+        try:
+            import wandb
+        except Exception:
+            log_dist("wandb unavailable; disabling", ranks=[0])
+            return
+        wandb.init(project=cfg.get("project", "deepspeed_tpu"),
+                   group=cfg.get("group"), config=cfg)
+        self._wandb = wandb
+        self.enabled = True
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: value}, step=int(step))
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to every enabled writer (reference ``MonitorMaster:30``)."""
+
+    def __init__(self, config: MonitorConfig):
+        self.writers: list[Monitor] = []
+        if config.enabled:
+            if config.tensorboard.get("enabled"):
+                self.writers.append(TensorBoardMonitor(config.tensorboard))
+            if config.csv_monitor.get("enabled"):
+                self.writers.append(CSVMonitor(config.csv_monitor))
+            if config.wandb.get("enabled"):
+                self.writers.append(WandbMonitor(config.wandb))
+        self.enabled = any(w.enabled for w in self.writers)
+
+    def write_events(self, event_list):
+        for w in self.writers:
+            w.write_events(event_list)
+
+    def flush(self):
+        for w in self.writers:
+            w.flush()

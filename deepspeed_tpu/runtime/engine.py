@@ -170,6 +170,10 @@ class Engine:
                 * config.sequence_length
             )
 
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+        self.monitor = MonitorMaster(config.monitor)
+
         self._train_batch_jit = None
         self._accum_jit = None
         self._apply_jit = None
@@ -424,6 +428,20 @@ class Engine:
             )
         self.lr_scheduler.step()
         self._last_metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        if self.monitor.enabled:
+            # reference tags (engine.py:3360-3390 _write_monitor)
+            events = [
+                ("Train/Samples/lr", float(self._last_metrics["lr"]), self.global_samples),
+                ("Train/Samples/grad_norm", float(self._last_metrics["grad_norm"]),
+                 self.global_samples),
+            ]
+            if "loss" in self._last_metrics:
+                events.append(("Train/Samples/train_loss",
+                               float(self._last_metrics["loss"]), self.global_samples))
+            if self.config.fp16.enabled:
+                events.append(("Train/Samples/loss_scale",
+                               float(self._last_metrics["loss_scale"]), self.global_samples))
+            self.monitor.write_events(events)
         if self.config.steps_per_print and self.global_steps % self.config.steps_per_print == 0:
             loss = self._last_metrics.get("loss")
             loss_str = f"loss={float(loss):.4f} " if loss is not None else ""

@@ -1,0 +1,110 @@
+"""Aux subsystems: monitor writers, flops profiler, launcher parsing, elasticity
+(reference: ``tests/unit/monitor``, ``profiling``, ``launcher``, ``elasticity``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config.base import ConfigError
+from deepspeed_tpu.config.config import MonitorConfig
+from deepspeed_tpu.elasticity.elasticity import (
+    compute_elastic_config,
+    ensure_immutable_elastic_config,
+    get_compatible_world_sizes,
+)
+from deepspeed_tpu.launcher import runner
+from deepspeed_tpu.monitor.monitor import CSVMonitor, MonitorMaster
+
+
+# ----------------------------------------------------------------- monitor
+def test_csv_monitor_writes(tmp_path):
+    mon = CSVMonitor({"output_path": str(tmp_path), "job_name": "job"})
+    mon.write_events([("Train/Samples/train_loss", 1.5, 10),
+                      ("Train/Samples/train_loss", 1.2, 20)])
+    path = tmp_path / "job" / "Train_Samples_train_loss.csv"
+    lines = path.read_text().strip().splitlines()
+    assert lines[0].startswith("step")
+    assert lines[1] == "10,1.5"
+    assert lines[2] == "20,1.2"
+
+
+def test_monitor_master_fanout(tmp_path):
+    cfg = MonitorConfig(enabled=True,
+                        csv_monitor={"enabled": True, "output_path": str(tmp_path)})
+    master = MonitorMaster(cfg)
+    assert master.enabled
+    master.write_events([("x", 1.0, 1)])
+    master.flush()
+    assert (tmp_path / "dstpu" / "x.csv").exists()
+
+
+def test_monitor_disabled_by_default():
+    assert not MonitorMaster(MonitorConfig()).enabled
+
+
+# ----------------------------------------------------------------- flops profiler
+def test_flops_profiler_analytic_and_compiled():
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.profiling.flops_profiler import get_model_profile
+
+    spec = llama.build(llama.LlamaConfig.tiny(256))
+    prof = get_model_profile(spec, batch=2, seq=16)
+    assert prof.params == spec.num_params
+    assert prof.flops_fwd > 0
+    assert set(prof.breakdown) == {"qkv+out", "attention", "mlp", "lm_head"}
+    # XLA cost model should report flops in the same order of magnitude
+    if "flops" in prof.compiled:
+        assert prof.compiled["flops"] == pytest.approx(prof.flops_fwd, rel=1.0)
+
+
+# ----------------------------------------------------------------- launcher
+def test_hostfile_parse_and_filter(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("# cluster\nworker-0 slots=4\nworker-1 slots=4\nworker-2 slots=8\n")
+    hosts = runner.fetch_hostfile(str(hf))
+    assert hosts == {"worker-0": 4, "worker-1": 4, "worker-2": 8}
+    sel = runner.filter_hosts(hosts, include="worker-0@worker-2")
+    assert list(sel) == ["worker-0", "worker-2"]
+    sel = runner.filter_hosts(hosts, exclude="worker-1")
+    assert "worker-1" not in sel
+    with pytest.raises(ValueError):
+        runner.filter_hosts(hosts, include="nope")
+
+
+def test_hostfile_duplicate_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("a slots=1\na slots=2\n")
+    with pytest.raises(ValueError):
+        runner.fetch_hostfile(str(hf))
+
+
+def test_node_cmd_env():
+    cmd = runner.build_node_cmd("train.py", ["--foo", "1"], "h0:29500", 4, 2,
+                                {"XLA_FLAGS": "--xla_dump_to=/tmp/d"})
+    assert "export DSTPU_COORDINATOR=h0:29500;" in cmd
+    assert "export DSTPU_NUM_PROCESSES=4;" in cmd
+    assert "export DSTPU_PROCESS_ID=2;" in cmd
+    assert "train.py --foo 1" in cmd
+
+
+# ----------------------------------------------------------------- elasticity
+def test_compatible_world_sizes():
+    # batch 64, micro in {2,4}: every w dividing 32 works
+    valid = get_compatible_world_sizes(64, [2, 4], 1, 16)
+    assert 8 in valid and 16 in valid and 5 not in valid
+
+
+def test_compute_elastic_config():
+    ec = compute_elastic_config(target_batch_size=64, micro_batches=[2, 4, 8],
+                                max_world_size=8)
+    assert ec.final_batch_size >= 32
+    assert all(ec.final_batch_size % (ec.micro_batch_per_world[w] * w) == 0
+               for w in ec.valid_world_sizes)
+
+
+def test_elastic_immutable_guard():
+    frozen = {"train_batch_size": 64}
+    ensure_immutable_elastic_config({"train_batch_size": 64}, frozen)
+    with pytest.raises(ConfigError):
+        ensure_immutable_elastic_config({"train_batch_size": 32}, frozen)
