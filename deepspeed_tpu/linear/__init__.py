@@ -1,0 +1,8 @@
+from deepspeed_tpu.linear.optimized_linear import (  # noqa: F401
+    LoRAConfig,
+    QuantizationConfig,
+    QuantizedParameter,
+    init_lora,
+    lora_linear,
+    optimized_linear,
+)

@@ -1,0 +1,69 @@
+"""OptimizedLinear: quantized base weights + LoRA adapters.
+
+Role parity with the reference ``linear/optimized_linear.py:18,76``
+(``OptimizedLinear``: shardable base weight + LoRA low-rank adapters) and
+``linear/quantization.py`` (``QuantizedParameter``: int8/int4 storage,
+dequantize-on-use). Functional form: the "parameter" is a small pytree;
+``optimized_linear`` applies it. The base weight stays frozen (int8) while the
+LoRA factors train — exactly the reference's memory story.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer import QuantizedTensor, dequantize, quantize
+
+
+@dataclass(frozen=True)
+class QuantizationConfig:
+    q_bits: int = 8
+    group_size: int = 256
+
+
+@dataclass(frozen=True)
+class LoRAConfig:
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1  # parity field; sharding comes from the planner
+
+
+def QuantizedParameter(w: jnp.ndarray, cfg: QuantizationConfig = QuantizationConfig()
+                       ) -> QuantizedTensor:
+    """Quantize a weight for frozen storage (reference ``QuantizedParameter``)."""
+    return quantize(w, bits=cfg.q_bits, block=cfg.group_size)
+
+
+def init_lora(rng, in_dim: int, out_dim: int, cfg: LoRAConfig) -> dict:
+    """LoRA factors: A ~ N(0, 1/r), B = 0 (so the adapter starts as identity)."""
+    ka, _ = jax.random.split(rng)
+    return {
+        "lora_a": jax.random.normal(ka, (in_dim, cfg.lora_r), jnp.float32)
+        / jnp.sqrt(cfg.lora_r),
+        "lora_b": jnp.zeros((cfg.lora_r, out_dim), jnp.float32),
+    }
+
+
+def lora_linear(x: jnp.ndarray, lora: dict, scaling: float) -> jnp.ndarray:
+    return (x @ lora["lora_a"].astype(x.dtype)) @ lora["lora_b"].astype(x.dtype) * scaling
+
+
+def optimized_linear(
+    x: jnp.ndarray,
+    base: QuantizedTensor | jnp.ndarray,
+    lora: dict | None = None,
+    lora_cfg: LoRAConfig | None = None,
+    bias: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """y = x @ dequant(base) [+ lora(x)] [+ bias]."""
+    w = dequantize(base, dtype=x.dtype) if isinstance(base, QuantizedTensor) else base
+    y = x @ w.astype(x.dtype)
+    if lora is not None:
+        cfg = lora_cfg or LoRAConfig()
+        y = y + lora_linear(x, lora, cfg.lora_alpha / cfg.lora_r)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y

@@ -53,6 +53,10 @@ class ShardCtx:
     sp_mode: str = "ulysses"  # ulysses | ring (reference: deepspeed/sequence/)
     attn_impl: str = "auto"
     pp_microbatches: int = 0  # 0 -> pipeline degree
+    # activation checkpointing (reference: runtime/activation_checkpointing/):
+    # engine fills these from config; model builders default to them
+    remat: bool = False
+    remat_policy: Any = None
 
     @property
     def sp_degree(self) -> int:

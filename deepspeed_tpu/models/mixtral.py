@@ -179,8 +179,10 @@ def flops_per_token(cfg: MixtralConfig, seq_len: int) -> float:
 
 
 def build(cfg: MixtralConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto",
-          remat: bool = False, remat_policy=None) -> ModelSpec:
+          remat: bool | None = None, remat_policy=None) -> ModelSpec:
     ctx = ctx or ShardCtx()
+    remat = ctx.remat if remat is None else remat
+    remat_policy = remat_policy if remat_policy is not None else ctx.remat_policy
     fwd = partial(forward, cfg, ctx=ctx, attn_impl=attn_impl,
                   remat=remat, remat_policy=remat_policy, train=False)
 

@@ -1,0 +1,73 @@
+"""Block quantization primitives (int8 / int4, symmetric per-block scales).
+
+Role parity with the reference quantizer kernels
+(``csrc/quantization/{quantize,dequantize,quant_reduce,swizzled_quantize}.cu``)
+used by ZeRO++ (qwZ quantized weights, qgZ quantized gradient collectives) and
+inference WOQ. On TPU these are jnp expressions XLA fuses into surrounding
+ops; the int4 packing uses two nibbles per int8 lane.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedTensor(NamedTuple):
+    values: jnp.ndarray   # int8 payload (int4: packed two-per-byte)
+    scales: jnp.ndarray   # f32 per-block scales
+    shape: tuple          # original shape
+    bits: int             # 8 or 4
+    block: int
+
+
+def _to_blocks(x: jnp.ndarray, block: int):
+    flat = x.reshape(-1)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, block), pad
+
+
+def quantize(x: jnp.ndarray, bits: int = 8, block: int = 256) -> QuantizedTensor:
+    """Symmetric per-block quantization (reference ``quantize.cu`` semantics)."""
+    assert bits in (8, 4), bits
+    blocks, _ = _to_blocks(x.astype(jnp.float32), block)
+    qmax = 127.0 if bits == 8 else 7.0
+    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / qmax
+    q = jnp.clip(jnp.round(blocks / scale), -qmax, qmax).astype(jnp.int8)
+    if bits == 4:
+        lo = q[:, 0::2] & 0x0F
+        hi = (q[:, 1::2] & 0x0F) << 4
+        q = (lo | hi).astype(jnp.int8)
+    return QuantizedTensor(values=q, scales=scale[:, 0], shape=tuple(x.shape),
+                           bits=bits, block=block)
+
+
+def dequantize(qt: QuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
+    """Reference ``dequantize.cu`` semantics."""
+    q = qt.values
+    if qt.bits == 4:
+        lo = (q << 4).astype(jnp.int8) >> 4          # sign-extend low nibble
+        hi = q >> 4                                   # arithmetic shift keeps sign
+        q = jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
+    vals = q.astype(jnp.float32) * qt.scales[:, None]
+    flat = vals.reshape(-1)
+    size = 1
+    for s in qt.shape:
+        size *= s
+    return flat[:size].reshape(qt.shape).astype(dtype)
+
+
+def quantize_dequantize(x: jnp.ndarray, bits: int = 8, block: int = 256) -> jnp.ndarray:
+    """Fake-quant round trip (reference ``fake_quantizer.cu``; QAT + tests)."""
+    return dequantize(quantize(x, bits=bits, block=block), dtype=x.dtype)
+
+
+def quantization_error(x: jnp.ndarray, bits: int = 8, block: int = 256) -> jnp.ndarray:
+    """Residual for error-feedback compression (1-bit Adam family,
+    ``runtime/comm/compressed.py`` semantics)."""
+    return x - quantize_dequantize(x, bits=bits, block=block)

@@ -95,6 +95,8 @@ class Engine:
             mesh=topo.mesh,
             sp_mode=config.sequence_parallel.mode,
             pp_microbatches=config.pipeline.num_microbatches,
+            remat=config.activation_checkpointing.enabled,
+            remat_policy=_resolve_remat_policy(config.activation_checkpointing.policy),
         )
         self.model_spec = model(self.shard_ctx) if callable(model) else model
         self.training_dataloader = training_data
