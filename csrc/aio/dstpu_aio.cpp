@@ -1,0 +1,186 @@
+// Async file I/O engine for the NVMe offload tier.
+//
+// Role parity with the reference DeepNVMe AIO stack (csrc/aio/py_lib:
+// deepspeed_aio_thread.cpp thread pool, deepspeed_py_aio_handle.cpp
+// submit/wait API, deepspeed_pin_tensor.cpp pinned buffers) — rebuilt for the
+// TPU-VM host: a pthread worker pool draining a request queue of
+// pread/pwrite jobs against O_DIRECT-capable files, exposed as a flat C ABI
+// for ctypes (no pybind11 in this image).
+//
+// The reference uses libaio; a thread pool over pread/pwrite reaches the same
+// NVMe queue depths on modern kernels (io_uring/libaio matter most for QD>>64,
+// far beyond what optimizer-state swapping generates) and stays portable.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Request {
+  int id;
+  bool is_write;
+  std::string path;
+  void* buf;
+  size_t nbytes;
+};
+
+struct Completion {
+  ssize_t result;  // bytes transferred or -errno
+};
+
+class AioEngine {
+ public:
+  AioEngine(int num_threads, size_t block_size)
+      : block_size_(block_size ? block_size : (1 << 20)), stop_(false), next_id_(1) {
+    if (num_threads < 1) num_threads = 1;
+    for (int i = 0; i < num_threads; ++i) {
+      workers_.emplace_back([this] { this->worker(); });
+    }
+  }
+
+  ~AioEngine() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  int submit(bool is_write, const char* path, void* buf, size_t nbytes) {
+    std::unique_lock<std::mutex> lk(mu_);
+    int id = next_id_++;
+    queue_.push_back(Request{id, is_write, path, buf, nbytes});
+    pending_.insert(id);
+    cv_.notify_one();
+    return id;
+  }
+
+  // blocks until request `id` completes; returns bytes transferred or -errno
+  ssize_t wait(int id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] { return completions_.count(id) > 0; });
+    ssize_t r = completions_[id].result;
+    completions_.erase(id);
+    return r;
+  }
+
+  // waits for every submitted request; returns 0 or first negative errno
+  ssize_t wait_all() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] { return pending_.empty(); });
+    ssize_t rc = 0;
+    for (auto& kv : completions_) {
+      if (kv.second.result < 0 && rc == 0) rc = kv.second.result;
+    }
+    completions_.clear();
+    return rc;
+  }
+
+ private:
+  void worker() {
+    for (;;) {
+      Request req;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stop_ || !queue_.empty(); });
+        if (stop_ && queue_.empty()) return;
+        req = queue_.front();
+        queue_.pop_front();
+      }
+      ssize_t result = execute(req);
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        completions_[req.id] = Completion{result};
+        pending_.erase(req.id);
+      }
+      done_cv_.notify_all();
+    }
+  }
+
+  ssize_t execute(const Request& req) {
+    int flags = req.is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+    int fd = ::open(req.path.c_str(), flags, 0644);
+    if (fd < 0) return -errno;
+    size_t off = 0;
+    char* p = static_cast<char*>(req.buf);
+    while (off < req.nbytes) {
+      size_t chunk = std::min(block_size_, req.nbytes - off);
+      ssize_t n = req.is_write ? ::pwrite(fd, p + off, chunk, (off_t)off)
+                               : ::pread(fd, p + off, chunk, (off_t)off);
+      if (n < 0) {
+        int e = errno;
+        ::close(fd);
+        return -e;
+      }
+      if (n == 0) break;  // EOF on read
+      off += (size_t)n;
+    }
+    if (req.is_write && ::fsync(fd) != 0) {
+      int e = errno;
+      ::close(fd);
+      return -e;
+    }
+    ::close(fd);
+    return (ssize_t)off;
+  }
+
+  size_t block_size_;
+  bool stop_;
+  int next_id_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  std::deque<Request> queue_;
+  std::unordered_map<int, Completion> completions_;
+  std::unordered_map<int, int> pending_map_unused_;
+  std::vector<std::thread> workers_;
+  // pending ids (separate from completions)
+  struct IdSet {
+    std::unordered_map<int, bool> m;
+    void insert(int id) { m[id] = true; }
+    void erase(int id) { m.erase(id); }
+    bool empty() const { return m.empty(); }
+    size_t count(int id) const { return m.count(id); }
+  } pending_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dstpu_aio_create(int num_threads, uint64_t block_size) {
+  return new AioEngine(num_threads, (size_t)block_size);
+}
+
+void dstpu_aio_destroy(void* h) { delete static_cast<AioEngine*>(h); }
+
+int dstpu_aio_submit_write(void* h, const char* path, const void* buf, uint64_t n) {
+  return static_cast<AioEngine*>(h)->submit(true, path, const_cast<void*>(buf), (size_t)n);
+}
+
+int dstpu_aio_submit_read(void* h, const char* path, void* buf, uint64_t n) {
+  return static_cast<AioEngine*>(h)->submit(false, path, buf, (size_t)n);
+}
+
+int64_t dstpu_aio_wait(void* h, int id) {
+  return (int64_t) static_cast<AioEngine*>(h)->wait(id);
+}
+
+int64_t dstpu_aio_wait_all(void* h) {
+  return (int64_t) static_cast<AioEngine*>(h)->wait_all();
+}
+
+}  // extern "C"

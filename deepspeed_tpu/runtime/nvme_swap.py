@@ -1,0 +1,118 @@
+"""NVMe tensor swap tier (ZeRO-Infinity's disk tier).
+
+Role parity with the reference ``runtime/swap_tensor``
+(``partitioned_optimizer_swapper.py:27``, ``async_swapper.py``,
+``pipelined_optimizer_swapper.py:52``): tensors swap between host memory and
+NVMe files through the native AIO engine (``csrc/aio/dstpu_aio.cpp``), with
+async submit/wait so writes overlap the next step's compute and reads prefetch
+ahead of use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class AsyncTensorSwapper:
+    """Swap numpy arrays (or pytrees of them) to files under ``base_dir``.
+
+    Reference ``AsyncPartitionedParameterSwapper`` behaviors kept: buffers are
+    owned by the swapper (host pinned memory ≙ page-locked numpy), writes are
+    async with a commit point (``wait_all``), reads can be issued early
+    (prefetch) and awaited at use.
+    """
+
+    def __init__(self, base_dir: str, num_threads: int = 4, block_size: int = 1 << 20):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.dstpu_aio_create(num_threads, block_size)
+        self._inflight: dict[str, int] = {}
+        self._buffers: dict[str, np.ndarray] = {}
+
+    def close(self):
+        if self._h is not None:
+            self._lib.dstpu_aio_wait_all(self._h)
+            self._lib.dstpu_aio_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.base_dir, key.replace("/", "_") + ".swp")
+
+    # ------------------------------------------------------------- write path
+    def swap_out(self, key: str, array) -> None:
+        """Async write; the array is snapshotted into a swapper-owned buffer so
+        the caller may free/mutate theirs immediately."""
+        buf = np.ascontiguousarray(np.asarray(array))
+        self._buffers[key] = buf  # keep alive until commit
+        req = self._lib.dstpu_aio_submit_write(
+            self._h, self._path(key).encode(), buf.ctypes.data_as(ctypes.c_void_p),
+            buf.nbytes,
+        )
+        self._inflight[key] = req
+
+    def swap_out_tree(self, prefix: str, tree: Any) -> list[str]:
+        keys = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            key = prefix + jax.tree_util.keystr(path)
+            self.swap_out(key, leaf)
+            keys.append(key)
+        return keys
+
+    def commit(self) -> None:
+        """Barrier for all outstanding writes (the GAS-boundary commit point,
+        reference ``engine.py:3271-3274``)."""
+        rc = self._lib.dstpu_aio_wait_all(self._h)
+        if rc < 0:
+            raise OSError(-rc, f"NVMe swap write failed under {self.base_dir}")
+        self._inflight.clear()
+        self._buffers.clear()
+
+    # -------------------------------------------------------------- read path
+    def prefetch(self, key: str, shape, dtype) -> None:
+        """Issue an async read ahead of use (reference pipelined swapper)."""
+        buf = np.empty(shape, dtype)
+        self._buffers[key] = buf
+        req = self._lib.dstpu_aio_submit_read(
+            self._h, self._path(key).encode(), buf.ctypes.data_as(ctypes.c_void_p),
+            buf.nbytes,
+        )
+        self._inflight[key] = req
+
+    def swap_in(self, key: str, shape=None, dtype=None) -> np.ndarray:
+        """Await (or issue+await) the read for ``key``."""
+        if key not in self._inflight:
+            if shape is None or dtype is None:
+                raise KeyError(f"{key} not prefetched and no shape/dtype given")
+            self.prefetch(key, shape, dtype)
+        rc = self._lib.dstpu_aio_wait(self._h, self._inflight.pop(key))
+        buf = self._buffers.pop(key)
+        if rc != buf.nbytes:
+            raise OSError(f"NVMe swap read of {key} returned {rc}, expected {buf.nbytes}")
+        return buf
+
+    def swap_in_tree(self, prefix: str, template: Any) -> Any:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+        for path, leaf in flat:
+            key = prefix + jax.tree_util.keystr(path)
+            if key not in self._inflight:
+                self.prefetch(key, np.asarray(leaf).shape, np.asarray(leaf).dtype)
+        leaves = [
+            self.swap_in(prefix + jax.tree_util.keystr(path))
+            for path, _ in flat
+        ]
+        return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,71 @@
+"""Native AIO engine + NVMe swapper (reference: ``tests/unit/ops/aio``,
+``runtime/swap_tensor`` suites). Compiles the C++ module on first run."""
+
+import ctypes
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+from deepspeed_tpu.runtime.nvme_swap import AsyncTensorSwapper
+
+
+@pytest.fixture(scope="module")
+def aio_lib():
+    builder = AsyncIOBuilder()
+    if not builder.is_compatible():
+        pytest.skip("no g++ toolchain")
+    return builder.load()
+
+
+def test_raw_write_read_roundtrip(aio_lib, tmp_path):
+    h = aio_lib.dstpu_aio_create(2, 1 << 16)
+    data = np.random.default_rng(0).standard_normal(100_000).astype(np.float32)
+    out = np.empty_like(data)
+    path = str(tmp_path / "blob.bin").encode()
+
+    wid = aio_lib.dstpu_aio_submit_write(h, path, data.ctypes.data_as(ctypes.c_void_p), data.nbytes)
+    assert aio_lib.dstpu_aio_wait(h, wid) == data.nbytes
+    rid = aio_lib.dstpu_aio_submit_read(h, path, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+    assert aio_lib.dstpu_aio_wait(h, rid) == out.nbytes
+    np.testing.assert_array_equal(out, data)
+    aio_lib.dstpu_aio_destroy(h)
+
+
+def test_missing_file_returns_errno(aio_lib, tmp_path):
+    h = aio_lib.dstpu_aio_create(1, 0)
+    buf = np.zeros(16, np.float32)
+    rid = aio_lib.dstpu_aio_submit_read(h, str(tmp_path / "nope").encode(),
+                                        buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes)
+    assert aio_lib.dstpu_aio_wait(h, rid) < 0
+    aio_lib.dstpu_aio_destroy(h)
+
+
+def test_swapper_tree_roundtrip(tmp_path):
+    if not AsyncIOBuilder().is_compatible():
+        pytest.skip("no g++ toolchain")
+    swapper = AsyncTensorSwapper(str(tmp_path), num_threads=2)
+    tree = {
+        "mu": {"w": np.random.default_rng(1).standard_normal((64, 32)).astype(np.float32)},
+        "nu": {"w": np.random.default_rng(2).standard_normal((64, 32)).astype(np.float32)},
+    }
+    swapper.swap_out_tree("opt", tree)
+    swapper.commit()
+    back = swapper.swap_in_tree("opt", jax.tree_util.tree_map(np.zeros_like, tree))
+    for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(a, b)
+    swapper.close()
+
+
+def test_swapper_many_concurrent_writes(tmp_path):
+    if not AsyncIOBuilder().is_compatible():
+        pytest.skip("no g++ toolchain")
+    swapper = AsyncTensorSwapper(str(tmp_path), num_threads=4)
+    arrays = {f"a{i}": np.full((1000,), i, np.float32) for i in range(32)}
+    for k, v in arrays.items():
+        swapper.swap_out(k, v)
+    swapper.commit()
+    for k, v in arrays.items():
+        np.testing.assert_array_equal(swapper.swap_in(k, v.shape, v.dtype), v)
+    swapper.close()
