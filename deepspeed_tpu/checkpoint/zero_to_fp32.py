@@ -1,0 +1,60 @@
+"""Consolidate a checkpoint into a single full-precision state file.
+
+Role parity with the reference ``utils/zero_to_fp32.py`` (offline script
+reconstructing a full fp32 state_dict from ZeRO shards). Our on-disk format is
+already universal (full per-param arrays — see ``checkpoint/serialization.py``),
+so "consolidation" is format conversion: ``model.npz`` -> one ``.npz`` or a
+torch-loadable ``.pt`` (via the CPU torch in the image) for handoff to
+non-JAX consumers.
+
+Usage:
+    python -m deepspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <out.npz|out.pt>
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint import engine as ckpt_engine
+from deepspeed_tpu.checkpoint import serialization as ser
+
+
+def get_fp32_state_dict_from_checkpoint(ckpt_dir: str, tag: str | None = None
+                                        ) -> dict[str, np.ndarray]:
+    """Reference ``get_fp32_state_dict_from_zero_checkpoint`` analog."""
+    tag = tag or ckpt_engine.latest_tag(ckpt_dir)
+    base = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
+    arrays = ser.load_arrays(os.path.join(base, "model.npz"))
+    return {
+        key.replace("['", "").replace("']", ".").rstrip("."): arr.astype(np.float32)
+        for key, arr in arrays.items()
+    }
+
+
+def convert_checkpoint_to_fp32_state_file(ckpt_dir: str, output_path: str,
+                                          tag: str | None = None) -> None:
+    state = get_fp32_state_dict_from_checkpoint(ckpt_dir, tag)
+    if output_path.endswith(".pt"):
+        import torch
+
+        torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in state.items()},
+                   output_path)
+    else:
+        np.savez(output_path, **state)
+    total = sum(v.size for v in state.values())
+    print(f"wrote {len(state)} tensors ({total:,} params) to {output_path}")
+
+
+def main() -> int:
+    if len(sys.argv) != 3:
+        print(__doc__)
+        return 1
+    convert_checkpoint_to_fp32_state_file(sys.argv[1], sys.argv[2])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,86 @@
+"""Data-efficiency pipeline: curriculum learning + dynamic batching hooks.
+
+Role parity with the reference ``runtime/data_pipeline``
+(``curriculum_scheduler.py:11 CurriculumScheduler``: fixed_linear /
+fixed_root / fixed_discrete difficulty schedules over training steps, used to
+ramp sequence length) and the random-LTD token-dropping idea
+(``random_ltd``) — expressed as pure functions the dataloader applies.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from deepspeed_tpu.config.base import ConfigError
+
+
+@dataclass
+class CurriculumScheduler:
+    """Difficulty (e.g. sequence length) as a function of global step.
+
+    schedule_type: fixed_linear | fixed_root | fixed_discrete
+    (reference ``curriculum_scheduler.py`` semantics, including the
+    ``difficulty_step`` rounding used to keep shapes bucketed).
+    """
+
+    min_difficulty: int
+    max_difficulty: int
+    schedule_type: str = "fixed_linear"
+    total_curriculum_step: int = 1000
+    difficulty_step: int = 8
+    root_degree: int = 2
+    discrete_difficulties: list = field(default_factory=list)
+    discrete_max_steps: list = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.schedule_type not in ("fixed_linear", "fixed_root", "fixed_discrete"):
+            raise ConfigError(f"unknown curriculum schedule {self.schedule_type!r}")
+        if self.schedule_type == "fixed_discrete" and (
+            len(self.discrete_difficulties) != len(self.discrete_max_steps)
+        ):
+            raise ConfigError("fixed_discrete needs matching difficulties/max_steps")
+
+    def get_difficulty(self, global_step: int) -> int:
+        if self.schedule_type == "fixed_discrete":
+            for difficulty, max_step in zip(self.discrete_difficulties, self.discrete_max_steps):
+                if global_step < max_step:
+                    return difficulty
+            return self.discrete_difficulties[-1]
+        frac = min(1.0, max(0.0, global_step / max(1, self.total_curriculum_step)))
+        if self.schedule_type == "fixed_root":
+            frac = frac ** (1.0 / self.root_degree)
+        raw = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        stepped = math.floor(raw / self.difficulty_step) * self.difficulty_step
+        return int(min(self.max_difficulty, max(self.min_difficulty, stepped)))
+
+
+def apply_seqlen_curriculum(batch: dict, seq_len: int) -> dict:
+    """Truncate a token batch to the curriculum sequence length (the reference
+    applies curriculum via seqlen truncation in its GPT pipeline)."""
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        out[k] = v[:, :seq_len] if v.ndim >= 2 else v
+    return out
+
+
+def random_ltd_drop(batch: dict, keep_ratio: float, rng: np.random.Generator,
+                    protect_first: int = 1) -> dict:
+    """Random layerwise-token-dropping analog at the data layer
+    (reference ``random_ltd``): drop a random subset of token positions,
+    keeping the first ``protect_first`` tokens; all arrays with a seq dim are
+    gathered identically so inputs/labels stay aligned."""
+    ids = np.asarray(batch["input_ids"])
+    b, s = ids.shape[:2]
+    keep = max(protect_first, int(round(s * keep_ratio)))
+    scores = rng.random((b, s))
+    scores[:, :protect_first] = -1.0  # always kept, sorted first
+    idx = np.sort(np.argsort(scores, axis=1)[:, :keep], axis=1)
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        out[k] = np.take_along_axis(v, idx, axis=1) if v.ndim >= 2 and v.shape[1] == s else v
+    return out

@@ -1,0 +1,75 @@
+"""Hybrid engine: train + generate on the same weights (RLHF loop).
+
+Role parity with the reference ``runtime/hybrid_engine.py:30
+DeepSpeedHybridEngine`` (mode-switching between training and inference for
+RLHF: gather ZeRO-3 params into inference containers, generate rollouts, flip
+back to training).
+
+TPU-native shape: no containers or mode flips — the training engine's params
+ARE the generation params. ``generate`` casts the current fp32 masters to the
+inference dtype and runs the jitted KV-cache decode; ZeRO-3 sharded params
+stay sharded (GSPMD gathers per layer during decode exactly as in the training
+forward). The reference's ``_zero3_release`` bookkeeping disappears.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.engine import Engine
+
+
+class HybridEngine(Engine):
+    """Engine + in-place generation (``deepspeed.initialize(...)`` then RLHF)."""
+
+    def __init__(self, *args, inference_dtype=jnp.bfloat16, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.model_spec.decode_fn is None:
+            raise ValueError(f"model {self.model_spec.name} has no decode support")
+        self.inference_dtype = inference_dtype
+        self._gen_cache: dict = {}
+
+    def _build_generate(self, batch: int, prompt_len: int, max_new: int, sample: bool):
+        decode = self.model_spec.decode_fn
+        init_cache = self.model_spec.init_cache_fn
+        dtype = self.inference_dtype
+
+        def generate_fn(params, tokens, rng, temperature):
+            cparams = jax.tree_util.tree_map(
+                lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                params,
+            )
+            cache = init_cache(batch, prompt_len + max_new, dtype)
+            logits, cache = decode(cparams, tokens, cache, 0)
+            last = logits[:, prompt_len - 1].astype(jnp.float32)
+
+            def step(carry, i):
+                last, cache = carry
+                r = jax.random.fold_in(rng, i)
+                tok = (jax.random.categorical(r, last / temperature) if sample
+                       else jnp.argmax(last, axis=-1)).astype(jnp.int32)
+                logits, cache = decode(cparams, tok[:, None], cache, prompt_len + i)
+                return (logits[:, 0].astype(jnp.float32), cache), tok
+
+            (_, _), toks = jax.lax.scan(step, (last, cache), jnp.arange(max_new))
+            return toks.T
+
+        return jax.jit(generate_fn)
+
+    def generate(self, input_ids, max_new_tokens: int = 64, temperature: float = 0.0,
+                 seed: int | None = None):
+        """Rollout generation on the CURRENT training weights."""
+        input_ids = np.asarray(input_ids)
+        b, t = input_ids.shape
+        sample = temperature > 0.0
+        key = (b, t, max_new_tokens, sample)
+        if key not in self._gen_cache:
+            self._gen_cache[key] = self._build_generate(b, t, max_new_tokens, sample)
+        rng = jax.random.PRNGKey(seed) if seed is not None else self._next_rng()
+        toks = self._gen_cache[key](
+            self.params, jnp.asarray(input_ids), rng,
+            jnp.float32(max(temperature, 1e-6)),
+        )
+        return np.concatenate([input_ids, np.asarray(toks)], axis=1)

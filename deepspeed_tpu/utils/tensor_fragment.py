@@ -1,0 +1,85 @@
+"""Live param / optimizer-state access by name.
+
+Role parity with the reference ``utils/tensor_fragment.py`` (``safe_get_full_
+fp32_param``, ``safe_set_full_fp32_param``, ``safe_get_full_optimizer_state``,
+``safe_get_full_grad`` — the debugging/EMA APIs that reach through ZeRO's flat
+buffers). Here params are a pytree of (possibly sharded) jax.Arrays, so a
+"fragment" lookup is a path walk; gathered values come back as full numpy
+arrays regardless of the sharding plan.
+
+Names are pytree paths like ``"layers/wq"`` or ``"embed"``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _walk(tree: Any, name: str):
+    node = tree
+    parts = [p for p in name.replace("[", "/").replace("]", "").replace("'", "")
+             .split("/") if p]
+    for part in parts:
+        if isinstance(node, (list, tuple)):
+            node = node[int(part)]
+        else:
+            node = node[part]
+    return node, parts
+
+
+def _set(tree: Any, name: str, value):
+    node = tree
+    parts = [p for p in name.replace("[", "/").replace("]", "").replace("'", "")
+             .split("/") if p]
+    for part in parts[:-1]:
+        node = node[int(part)] if isinstance(node, (list, tuple)) else node[part]
+    node[parts[-1]] = value
+
+
+def list_param_names(engine) -> list[str]:
+    return [
+        jax.tree_util.keystr(path).replace("['", "/").replace("']", "").lstrip("/")
+        for path, _ in jax.tree_util.tree_flatten_with_path(engine.params)[0]
+    ]
+
+
+def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
+    """Full (gathered) fp32 master value of a parameter."""
+    leaf, _ = _walk(engine.params, name)
+    return np.asarray(leaf)
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> None:
+    """Overwrite a parameter, preserving its sharding (reference semantics:
+    the update is visible to the next step)."""
+    leaf, _ = _walk(engine.params, name)
+    new = jax.device_put(
+        np.asarray(value, dtype=leaf.dtype).reshape(leaf.shape), leaf.sharding
+    )
+    _set(engine.params, name, new)
+
+
+def safe_get_full_optimizer_state(engine, name: str, state_name: str = "mu") -> np.ndarray:
+    """Full value of an optimizer moment for a parameter (``exp_avg`` ->
+    ``mu``, ``exp_avg_sq`` -> ``nu`` in optax terms; both aliases accepted)."""
+    alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
+    state_name = alias.get(state_name, state_name)
+    for element in jax.tree_util.tree_leaves(
+        engine.opt_state, is_leaf=lambda x: hasattr(x, state_name)
+    ):
+        if hasattr(element, state_name):
+            leaf, _ = _walk(getattr(element, state_name), name)
+            return np.asarray(leaf)
+    raise KeyError(f"no optimizer state {state_name!r} found")
+
+
+def safe_get_full_grad(engine, name: str) -> np.ndarray | None:
+    """Accumulated gradient for a parameter (fwd/bwd protocol path only —
+    the fused ``train_batch`` consumes gradients inside one XLA program)."""
+    if engine._acc_grads is None:
+        return None
+    leaf, _ = _walk(engine._acc_grads, name)
+    return np.asarray(leaf)
