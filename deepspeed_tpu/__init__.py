@@ -17,6 +17,7 @@ __version__ = "0.1.0"
 from deepspeed_tpu.comm.comm import init_distributed  # noqa: F401
 from deepspeed_tpu.config.config import Config, load_config  # noqa: F401
 from deepspeed_tpu.accelerator.real_accelerator import get_accelerator  # noqa: F401
+from deepspeed_tpu.models.api import ModelSpec, ShardCtx  # noqa: F401
 
 
 def initialize(*args, **kwargs):

@@ -1,0 +1,123 @@
+"""Autotuner: measured search over ZeRO stage x micro-batch x remat configs.
+
+Role parity with the reference ``autotuning/autotuner.py:42`` (``tune:404``:
+profile model, generate ZeRO-stage x micro-batch experiments, run each, pick
+the best by throughput ``run_tuning_micro_batch_sizes:741``). The reference
+schedules experiments across free cluster nodes via the launcher; on TPU a
+trial is a fresh in-process engine (jit-compiled, measured for a few steps), so
+the whole search runs where the job runs. OOMs and compile failures are caught
+and recorded as failed trials, exactly like the reference's experiment records.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+TUNING_METRICS = ("throughput", "latency")
+
+
+@dataclass
+class TrialResult:
+    overrides: dict
+    samples_per_sec: float = 0.0
+    step_ms: float = 0.0
+    error: str | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+@dataclass
+class Autotuner:
+    """Measured config search (call ``tune()``)."""
+
+    model_builder: object
+    base_config: dict
+    metric: str = "throughput"
+    steps_per_trial: int = 3
+    results: list = field(default_factory=list)
+
+    def _run_trial(self, overrides: dict, seq_len: int, vocab: int) -> TrialResult:
+        import deepspeed_tpu
+        from deepspeed_tpu.comm.topology import reset_topology
+
+        cfg = dict(self.base_config)
+        zero = dict(cfg.get("zero_optimization", {}))
+        if "zero_stage" in overrides:
+            zero["stage"] = overrides["zero_stage"]
+        cfg["zero_optimization"] = zero
+        if "micro_batch" in overrides:
+            cfg["train_micro_batch_size_per_device"] = overrides["micro_batch"]
+            cfg.pop("train_batch_size", None)
+        if "remat" in overrides:
+            cfg["activation_checkpointing"] = {"enabled": overrides["remat"]}
+        cfg["steps_per_print"] = 0
+
+        try:
+            reset_topology()
+            engine, _, _, _ = deepspeed_tpu.initialize(model=self.model_builder, config=cfg)
+            rng = np.random.default_rng(0)
+
+            def batch():
+                return {"input_ids": rng.integers(
+                    0, vocab, (engine.train_batch_size, seq_len), dtype=np.int32)}
+
+            engine.train_batch(batch())  # compile
+            t0 = time.perf_counter()
+            for _ in range(self.steps_per_trial):
+                engine.train_batch(batch())
+            dt = (time.perf_counter() - t0) / self.steps_per_trial
+            return TrialResult(
+                overrides=overrides,
+                samples_per_sec=engine.train_batch_size / dt,
+                step_ms=dt * 1000,
+            )
+        except Exception as e:  # OOM / compile failure = failed experiment
+            return TrialResult(overrides=overrides, error=f"{type(e).__name__}: {e}"[:300])
+
+    def tune(
+        self,
+        micro_batch_sizes: list[int] = (1, 2, 4, 8),
+        zero_stages: list[int] = (0, 1, 2, 3),
+        seq_len: int = 128,
+        vocab: int = 1024,
+        try_remat: bool = False,
+    ) -> dict:
+        """Grid search; returns the best override dict (reference ``tune:404``).
+
+        Like the reference's micro-batch sweep, larger micro batches are tried
+        until one fails (OOM), per stage."""
+        self.results = []
+        for stage in zero_stages:
+            for mb in micro_batch_sizes:
+                overrides = {"zero_stage": stage, "micro_batch": mb}
+                variants = [dict(overrides)]
+                if try_remat:
+                    variants.append({**overrides, "remat": True})
+                oomed = False
+                for ov in variants:
+                    res = self._run_trial(ov, seq_len, vocab)
+                    self.results.append(res)
+                    log_dist(
+                        f"autotune {ov}: "
+                        + (f"{res.samples_per_sec:.1f} samples/s" if res.ok else f"FAILED {res.error}"),
+                        ranks=[0],
+                    )
+                    if not res.ok and "Resource" in (res.error or ""):
+                        oomed = True
+                if oomed:
+                    break  # bigger micro batches will OOM too
+        good = [r for r in self.results if r.ok]
+        if not good:
+            raise RuntimeError("autotuning: every trial failed")
+        best = (max(good, key=lambda r: r.samples_per_sec)
+                if self.metric == "throughput" else min(good, key=lambda r: r.step_ms))
+        log_dist(f"autotune best: {best.overrides} ({best.samples_per_sec:.1f} samples/s)",
+                 ranks=[0])
+        return best.overrides

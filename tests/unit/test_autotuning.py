@@ -1,0 +1,28 @@
+"""Autotuner: small measured grid search (reference: ``tests/unit/autotuning``)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner
+from deepspeed_tpu.models import llama
+
+VOCAB = 256
+
+
+def test_autotuner_picks_a_working_config():
+    tuner = Autotuner(
+        model_builder=lambda ctx: llama.build(llama.LlamaConfig.tiny(VOCAB), ctx=ctx),
+        base_config={
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "mesh": {"data": 8},
+        },
+        steps_per_trial=1,
+    )
+    best = tuner.tune(micro_batch_sizes=[2, 4], zero_stages=[0, 1],
+                      seq_len=16, vocab=VOCAB)
+    assert best["zero_stage"] in (0, 1)
+    assert best["micro_batch"] in (2, 4)
+    ok = [r for r in tuner.results if r.ok]
+    assert len(ok) == 4  # all trials viable at this size
+    assert max(r.samples_per_sec for r in ok) == \
+        next(r for r in ok if r.overrides == best).samples_per_sec
