@@ -6,54 +6,121 @@ utilization. Target from BASELINE.json: Llama-3-8B ZeRO-3 bf16 @ >=45% MFU on
 v5p-64; single-chip MFU is the per-chip proxy tracked across rounds
 (``vs_baseline`` = MFU / 0.45).
 
+OOM-safe by construction: the parent process never initializes the accelerator;
+each candidate config runs in its own subprocess (the autotuner's trial pattern,
+``deepspeed_tpu/autotuning/autotuner.py``), and on failure (RESOURCE_EXHAUSTED
+or anything else) the ladder backs off to a smaller config. Configs are sized
+from the device's HBM capacity by generation, not guessed.
+
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+# (bf16 peak FLOPs/s, HBM bytes) per chip by TPU generation (public spec sheets)
+CHIP_TABLE = {
+    "v5 lite": (197e12, 16e9), "v5e": (197e12, 16e9),
+    "v5p": (459e12, 95e9),
+    "v4": (275e12, 32e9),
+    "v6 lite": (918e12, 32e9), "v6e": (918e12, 32e9),
+    "v3": (123e12, 16e9),
+    "v2": (45e12, 8e9),
+    "v5": (459e12, 95e9),
+}
 
 
-def _peak_flops(device) -> float:
-    """bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)."""
-    kind = getattr(device, "device_kind", "").lower()
-    table = {
-        "v5 lite": 197e12, "v5e": 197e12,
-        "v5p": 459e12, "v5": 459e12,
-        "v4": 275e12,
-        "v6 lite": 918e12, "v6e": 918e12,
-        "v3": 123e12, "v2": 45e12,
-    }
-    for key, val in table.items():
+def chip_spec(device_kind: str):
+    kind = device_kind.lower()
+    for key, val in CHIP_TABLE.items():
         if key in kind:
             return val
-    return 197e12  # conservative default
+    print(f"bench: unknown device kind {device_kind!r}; assuming 197 TFLOPs / 16 GB",
+          file=sys.stderr)
+    return (197e12, 16e9)
 
 
-def main():
+def candidate_ladder(hbm_bytes: float):
+    """Descending ladder of (hidden, ffn, layers, vocab, heads, kv, batch, seq).
+
+    State bytes/param on the fused step path: fp32 master + Adam m/v (12) +
+    fp32 grad accumulator (4) + transient bf16 cast (2) = ~18. Each rung keeps
+    18*params plus a logits/activation estimate within ~80% of HBM; the
+    subprocess trial is still the ground truth.
+    """
+    if hbm_bytes >= 90e9:      # v5p-class
+        ladder = [
+            (4096, 14336, 16, 32768, 32, 8, 8, 2048),
+            (4096, 14336, 12, 32768, 32, 8, 8, 2048),
+            (2048, 5632, 16, 32768, 16, 8, 8, 2048),
+        ]
+    elif hbm_bytes >= 30e9:    # v4 / v6e-class
+        ladder = [
+            (2048, 5632, 16, 32768, 16, 8, 8, 2048),
+            (2048, 5632, 12, 32768, 16, 8, 8, 2048),
+            (2048, 5632, 8, 32768, 16, 8, 8, 2048),
+        ]
+    else:                      # 16 GB-class (v5e, v3)
+        ladder = [
+            (2048, 5632, 8, 32768, 16, 8, 8, 2048),
+            (2048, 5632, 8, 32768, 16, 8, 4, 2048),
+            (2048, 5632, 6, 32768, 16, 8, 4, 2048),
+            (1536, 4096, 8, 32768, 16, 8, 4, 2048),
+        ]
+    ladder.append((1024, 2816, 6, 16384, 16, 8, 4, 1024))  # safety net
+    return ladder
+
+
+def run_trial_subprocess(cfg_tuple, steps: int, timeout: float = 900.0):
+    env = dict(os.environ)
+    hidden, ffn, layers, vocab, heads, kv, batch, seq = cfg_tuple
+    env.update(
+        BENCH_TRIAL="1",
+        BENCH_HIDDEN=str(hidden), BENCH_FFN=str(ffn), BENCH_LAYERS=str(layers),
+        BENCH_VOCAB=str(vocab), BENCH_HEADS=str(heads), BENCH_KV=str(kv),
+        BENCH_BATCH=str(batch), BENCH_SEQ=str(seq), BENCH_STEPS=str(steps),
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    if proc.returncode != 0:
+        return None, (proc.stderr or proc.stdout)[-2000:]
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, "no JSON in trial output:\n" + proc.stdout[-2000:]
+
+
+def trial_main():
+    """Child process: build the engine from env, time steps, print one JSON line."""
+    import numpy as np
     import jax
 
     import deepspeed_tpu
     from deepspeed_tpu.models import llama
 
-    on_tpu = jax.default_backend() == "tpu"
-    # Sized to fit one chip's HBM with fp32 master + Adam moments (~18 B/param).
+    e = os.environ
     model_cfg = llama.LlamaConfig(
-        vocab_size=int(os.environ.get("BENCH_VOCAB", 32000)),
-        hidden_size=int(os.environ.get("BENCH_HIDDEN", 2048)),
-        intermediate_size=int(os.environ.get("BENCH_FFN", 5632)),
-        num_layers=int(os.environ.get("BENCH_LAYERS", 10)),
-        num_heads=16,
-        num_kv_heads=8,
-        max_seq_len=2048,
-    ) if on_tpu else llama.LlamaConfig.tiny(512)
-
-    seq = int(os.environ.get("BENCH_SEQ", 2048)) if on_tpu else 64
-    batch = int(os.environ.get("BENCH_BATCH", 16)) if on_tpu else 4
-    steps = int(os.environ.get("BENCH_STEPS", 10)) if on_tpu else 3
+        vocab_size=int(e["BENCH_VOCAB"]),
+        hidden_size=int(e["BENCH_HIDDEN"]),
+        intermediate_size=int(e["BENCH_FFN"]),
+        num_layers=int(e["BENCH_LAYERS"]),
+        num_heads=int(e["BENCH_HEADS"]),
+        num_kv_heads=int(e["BENCH_KV"]),
+        max_seq_len=int(e["BENCH_SEQ"]),
+    )
+    seq, batch, steps = int(e["BENCH_SEQ"]), int(e["BENCH_BATCH"]), int(e["BENCH_STEPS"])
 
     config = {
         "train_micro_batch_size_per_device": batch,
@@ -67,10 +134,7 @@ def main():
         "activation_checkpointing": {"enabled": True, "policy": "dots_saveable"},
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
-        model=lambda ctx: llama.build(
-            model_cfg, ctx=ctx, remat=True,
-            remat_policy=None,
-        ),
+        model=lambda ctx: llama.build(model_cfg, ctx=ctx, remat=True, remat_policy=None),
         config=config,
     )
 
@@ -79,35 +143,79 @@ def main():
     def make_batch():
         return {"input_ids": rng.integers(0, model_cfg.vocab_size, (batch, seq), dtype=np.int32)}
 
-    # warmup/compile
-    engine.train_batch(make_batch())
-    engine.train_batch(make_batch())
-
+    engine.train_batch(make_batch())  # compile
+    engine.train_batch(make_batch())  # warm
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch(make_batch())
     elapsed = time.perf_counter() - t0
 
     tokens_per_s = steps * batch * seq / elapsed
-    n = llama.num_params(model_cfg)
     flops_per_token = llama.flops_per_token(model_cfg, seq)
-    model_flops_per_s = tokens_per_s * flops_per_token
-    peak = _peak_flops(jax.devices()[0]) if on_tpu else 1e12
-    mfu = model_flops_per_s / peak
-
-    result = {
+    peak, _ = chip_spec(getattr(jax.devices()[0], "device_kind", ""))
+    if jax.default_backend() != "tpu":
+        peak = 1e12  # nominal denominator for CPU smoke runs
+    mfu = tokens_per_s * flops_per_token / peak
+    print(json.dumps({
         "metric": "llama_train_mfu_single_chip",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu / 0.45, 4),
         "tokens_per_s": round(tokens_per_s, 1),
-        "model_params": n,
+        "model_params": llama.num_params(model_cfg),
         "seq_len": seq,
+        "batch": batch,
         "final_loss": round(float(loss), 4),
         "device": str(jax.devices()[0].device_kind),
         "backend": jax.default_backend(),
-    }
-    print(json.dumps(result))
+    }))
+
+
+def probe_device():
+    """Probe backend/device kind in a throwaway subprocess so the parent never
+    holds the TPU (a held chip would make every trial subprocess fail to init)."""
+    code = (
+        "import jax, json;"
+        "d = jax.devices()[0];"
+        "print(json.dumps({'backend': jax.default_backend(),"
+        " 'kind': getattr(d, 'device_kind', '')}))"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError("device probe failed:\n" + proc.stderr[-2000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError("device probe produced no JSON")
+
+
+def main():
+    if os.environ.get("BENCH_TRIAL"):
+        return trial_main()
+
+    info = probe_device()
+    if info["backend"] != "tpu":
+        # CPU smoke mode: one tiny in-subprocess trial, nominal peak
+        result, err = run_trial_subprocess((256, 688, 2, 512, 4, 2, 4, 64), steps=3)
+        if result is None:
+            print(err, file=sys.stderr)
+            return 1
+        print(json.dumps(result))
+        return 0
+
+    _, hbm = chip_spec(info["kind"])
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+    errors = []
+    for rung in candidate_ladder(hbm):
+        result, err = run_trial_subprocess(rung, steps=steps)
+        if result is not None:
+            print(json.dumps(result))
+            return 0
+        errors.append(f"config {rung}: {err[-300:] if err else 'unknown'}")
+        print(f"bench rung {rung} failed, backing off:\n{err}", file=sys.stderr)
+    print("all bench rungs failed:\n" + "\n".join(errors), file=sys.stderr)
+    return 1
 
 
 if __name__ == "__main__":
