@@ -143,11 +143,14 @@ def trial_main():
     def make_batch():
         return {"input_ids": rng.integers(0, model_cfg.vocab_size, (batch, seq), dtype=np.int32)}
 
-    engine.train_batch(make_batch())  # compile
-    engine.train_batch(make_batch())  # warm
+    # settle via value fetch: block_until_ready can return early over the
+    # tunneled-TPU transport, a fetched scalar cannot
+    float(engine.train_batch(make_batch()))  # compile
+    float(engine.train_batch(make_batch()))  # warm
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch(make_batch())
+    loss = float(loss)  # steps dispatch async; settle before timing
     elapsed = time.perf_counter() - t0
 
     tokens_per_s = steps * batch * seq / elapsed
@@ -165,7 +168,7 @@ def trial_main():
         "model_params": llama.num_params(model_cfg),
         "seq_len": seq,
         "batch": batch,
-        "final_loss": round(float(loss), 4),
+        "final_loss": round(loss, 4),
         "device": str(jax.devices()[0].device_kind),
         "backend": jax.default_backend(),
     }))
@@ -206,6 +209,23 @@ def main():
 
     _, hbm = chip_spec(info["kind"])
     steps = int(os.environ.get("BENCH_STEPS", 10))
+
+    # explicit shape overrides pin a single config (no ladder)
+    shape_vars = ("BENCH_HIDDEN", "BENCH_FFN", "BENCH_LAYERS", "BENCH_VOCAB",
+                  "BENCH_HEADS", "BENCH_KV", "BENCH_BATCH", "BENCH_SEQ")
+    if any(v in os.environ for v in shape_vars):
+        e = os.environ
+        rung = (int(e.get("BENCH_HIDDEN", 2048)), int(e.get("BENCH_FFN", 5632)),
+                int(e.get("BENCH_LAYERS", 8)), int(e.get("BENCH_VOCAB", 32768)),
+                int(e.get("BENCH_HEADS", 16)), int(e.get("BENCH_KV", 8)),
+                int(e.get("BENCH_BATCH", 8)), int(e.get("BENCH_SEQ", 2048)))
+        result, err = run_trial_subprocess(rung, steps=steps)
+        if result is None:
+            print(f"pinned bench config {rung} failed:\n{err}", file=sys.stderr)
+            return 1
+        print(json.dumps(result))
+        return 0
+
     errors = []
     for rung in candidate_ladder(hbm):
         result, err = run_trial_subprocess(rung, steps=steps)

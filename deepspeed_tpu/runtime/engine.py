@@ -154,7 +154,8 @@ class Engine:
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
+        self._skip_base = 0              # skips restored from checkpoint
+        self._skip_dev = jnp.int32(0)    # async device-side skip accumulator
         self._last_metrics: dict = {}
         self._rng = jax.random.PRNGKey(seed + 1)
 
@@ -253,24 +254,33 @@ class Engine:
     def _build_train_batch_fn(self):
         gas = self.gas
 
-        def train_batch_fn(params, opt_state, scale_state, step, rng, batch):
+        def train_batch_fn(params, opt_state, scale_state, step, base_rng, batch):
             scale = scale_state.scale
-            acc0 = jax.tree_util.tree_map(
-                lambda p, s: jax.lax.with_sharding_constraint(
-                    jnp.zeros(p.shape, jnp.float32), s
-                ),
-                params,
-                self._grad_ns(),
-            )
+            # derive the step's rng on-device: no host random.split round trip
+            rng = jax.random.fold_in(base_rng, step)
 
-            def micro(acc, idx_mb):
-                idx, mb = idx_mb
-                r = jax.random.fold_in(rng, idx)
-                loss, grads = self._microbatch_grads(params, mb, r, scale)
-                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                return acc, loss
+            if gas == 1:
+                # fast path: no accumulation buffer, no scan machinery
+                mb = jax.tree_util.tree_map(lambda x: x[0], batch)
+                loss, acc = self._microbatch_grads(params, mb, rng, scale)
+                losses = loss[None]
+            else:
+                acc0 = jax.tree_util.tree_map(
+                    lambda p, s: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, jnp.float32), s
+                    ),
+                    params,
+                    self._grad_ns(),
+                )
 
-            acc, losses = jax.lax.scan(micro, acc0, (jnp.arange(gas), batch))
+                def micro(acc, idx_mb):
+                    idx, mb = idx_mb
+                    r = jax.random.fold_in(rng, idx)
+                    loss, grads = self._microbatch_grads(params, mb, r, scale)
+                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                    return acc, loss
+
+                acc, losses = jax.lax.scan(micro, acc0, (jnp.arange(gas), batch))
             new_params, new_opt, new_scale, metrics = self._update(
                 params, opt_state, scale_state, acc, float(gas), step
             )
@@ -351,10 +361,13 @@ class Engine:
             self.opt_state,
             self.scale_state,
             jnp.int32(self.global_steps),
-            self._next_rng(),
+            self._rng,
             dev_batch,
         )
-        metrics["loss"].block_until_ready()
+        # NO per-step device sync here: over a tunneled TPU each host<->device
+        # round trip costs more than the update tail; steps pipeline and Python
+        # overhead hides under device compute. _after_step syncs only when a
+        # consumer (monitor / steps_per_print / fp16 bookkeeping) needs values.
         self.tput_timer.stop(global_step=True)
         self._after_step(metrics)
         self.micro_steps += self.gas
@@ -420,17 +433,20 @@ class Engine:
     def _after_step(self, metrics):
         self.global_steps += 1
         self.global_samples += int(self.config.train_batch_size or 0)
-        skipped = bool(metrics["skipped"])
-        if skipped:
-            self.skipped_steps += 1
+        # accumulate skips on-device (async); synced lazily by .skipped_steps
+        self._skip_dev = self._skip_dev + metrics["skipped"].astype(jnp.int32)
+        # fp16 dynamic loss scaling wants per-step overflow visibility (and its
+        # tests assert the skip log); bf16 runs stay fully async.
+        if self.config.fp16.enabled and bool(metrics["skipped"]):
             log_dist(
                 f"step {self.global_steps}: overflow, skipping update "
                 f"(loss_scale -> {float(self.scale_state.scale)})",
                 ranks=[0],
             )
         self.lr_scheduler.step()
-        self._last_metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        self._last_metrics = metrics  # device arrays; fetched on demand
         if self.monitor.enabled:
+            self._last_metrics = {k: np.asarray(v) for k, v in metrics.items()}
             # reference tags (engine.py:3360-3390 _write_monitor)
             events = [
                 ("Train/Samples/lr", float(self._last_metrics["lr"]), self.global_samples),
@@ -445,12 +461,17 @@ class Engine:
                                float(self._last_metrics["loss_scale"]), self.global_samples))
             self.monitor.write_events(events)
         if self.config.steps_per_print and self.global_steps % self.config.steps_per_print == 0:
+            # this float() is the periodic settle point for the async pipeline;
+            # it also bounds ThroughputTimer drift (between prints the dispatch
+            # queue's backpressure makes host step time track device step time)
             loss = self._last_metrics.get("loss")
             loss_str = f"loss={float(loss):.4f} " if loss is not None else ""
+            skips = self.skipped_steps
+            skip_str = f"skipped={skips} " if skips else ""
             log_dist(
                 f"step={self.global_steps} {loss_str}"
                 f"lr={float(self._last_metrics['lr']):.3e} "
-                f"grad_norm={float(self._last_metrics['grad_norm']):.3f}",
+                f"grad_norm={float(self._last_metrics['grad_norm']):.3f} {skip_str}",
                 ranks=[0],
             )
 
@@ -555,6 +576,16 @@ class Engine:
         return ckpt_dir, manifest.get("client_state", {})
 
     # ------------------------------------------------------------------ accessors
+    @property
+    def skipped_steps(self) -> int:
+        """Total overflow-skipped steps (syncs the async device accumulator)."""
+        return self._skip_base + int(self._skip_dev)
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int):
+        self._skip_base = int(value)
+        self._skip_dev = jnp.int32(0)
+
     @property
     def loss_scale(self) -> float:
         return float(self.scale_state.scale)
