@@ -189,10 +189,13 @@ class SequenceParallelConfig(ConfigBase):
     mode: str = "ulysses"  # ulysses | ring
     tiled_mlp: bool = False
     tiled_logits: bool = False
+    tile_size: int = 1024  # sequence tokens per ALST compute tile
 
     def _validate(self, path: str = "") -> None:
         if self.mode not in ("ulysses", "ring"):
             raise ConfigError(f"{path}mode: must be ulysses|ring")
+        if self.tile_size <= 0:
+            raise ConfigError(f"{path}tile_size: must be positive")
 
 
 @dataclass

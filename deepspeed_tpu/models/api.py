@@ -57,6 +57,10 @@ class ShardCtx:
     # engine fills these from config; model builders default to them
     remat: bool = False
     remat_policy: Any = None
+    # ALST sequence tiling (reference ulysses_sp.py TiledMLP/TiledFusedLogitsLoss):
+    # 0 = off; otherwise tokens per tile
+    loss_tile_size: int = 0
+    mlp_tile_size: int = 0
 
     @property
     def sp_degree(self) -> int:

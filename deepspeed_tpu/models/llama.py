@@ -128,20 +128,28 @@ def _decoder_layer(cfg: LlamaConfig, ctx: ShardCtx, attn_impl: str,
     x = x + o.reshape(b, s, hq * hd) @ lp["wo"]
     x = ctx.constrain(x, "batch", "seq", "embed_act")
 
-    h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"])
-    up = h @ lp["w_up"]
-    gate = ctx.constrain(gate, "batch", "seq", "ffn_act")
-    x = x + (gate * up) @ lp["w_down"]
+    if ctx.mlp_tile_size:
+        from deepspeed_tpu.parallel.sequence_tiling import tiled_mlp
+
+        def mlp_fn(xs):
+            hs = rmsnorm(xs, lp["mlp_norm"], cfg.rms_norm_eps)
+            return (jax.nn.silu(hs @ lp["w_gate"]) * (hs @ lp["w_up"])) @ lp["w_down"]
+
+        x = x + tiled_mlp(mlp_fn, x, ctx.mlp_tile_size)
+    else:
+        h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"])
+        up = h @ lp["w_up"]
+        gate = ctx.constrain(gate, "batch", "seq", "ffn_act")
+        x = x + (gate * up) @ lp["w_down"]
     return ctx.constrain(x, "batch", "seq", "embed_act")
 
 
-def forward(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
-            ctx: ShardCtx | None = None, attn_impl: str = "auto",
-            remat_policy=None, remat: bool = False) -> jnp.ndarray:
-    """[B, S] int tokens -> [B, S, V] logits. Decoder is a scan over the layer stack."""
+def hidden_states(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
+                  ctx: ShardCtx | None = None, attn_impl: str = "auto",
+                  remat_policy=None, remat: bool = False) -> jnp.ndarray:
+    """[B, S] int tokens -> [B, S, D] final (post-norm) hidden states."""
     ctx = ctx or ShardCtx()
-    b, s = input_ids.shape
     x = params["embed"][input_ids]
     x = ctx.constrain(x, "batch", "seq", "embed_act")
 
@@ -150,9 +158,21 @@ def forward(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
         layer = jax.checkpoint(layer, policy=remat_policy)
 
     x = ctx.layer_stack(layer, params["layers"], x)
-    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = x @ head.astype(x.dtype)
+    return rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
+def lm_head(cfg: LlamaConfig, params: dict) -> jnp.ndarray:
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def forward(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
+            ctx: ShardCtx | None = None, attn_impl: str = "auto",
+            remat_policy=None, remat: bool = False) -> jnp.ndarray:
+    """[B, S] int tokens -> [B, S, V] logits. Decoder is a scan over the layer stack."""
+    ctx = ctx or ShardCtx()
+    x = hidden_states(cfg, params, input_ids, ctx=ctx, attn_impl=attn_impl,
+                      remat_policy=remat_policy, remat=remat)
+    logits = x @ lm_head(cfg, params).astype(x.dtype)
     return ctx.constrain(logits, "batch", "seq", "vocab_act")
 
 
@@ -245,6 +265,16 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
 
     def loss_fn(params, batch, rng=None):
         del rng  # no dropout in llama
+        if ctx.loss_tile_size:
+            from deepspeed_tpu.parallel.sequence_tiling import tiled_causal_lm_loss
+
+            x = hidden_states(cfg, params, batch["input_ids"], ctx=ctx,
+                              attn_impl=attn_impl, remat=remat,
+                              remat_policy=remat_policy)
+            return tiled_causal_lm_loss(
+                x, lm_head(cfg, params), batch["input_ids"], batch.get("labels"),
+                tile_size=ctx.loss_tile_size,
+            )
         logits = fwd(params, batch["input_ids"])
         return causal_lm_loss(logits, batch["input_ids"], batch.get("labels"))
 

@@ -1,0 +1,129 @@
+"""ALST sequence-tiled compute: tiled fused logits loss + tiled MLP.
+
+Long-context training OOMs on the loss head long before attention: a
+``[B, S, V]`` logits tensor at 128K tokens is tens of GB regardless of how well
+attention is sharded. The reference solves this with
+``TiledFusedLogitsLoss`` / ``TiledMLP`` (``/root/reference/deepspeed/runtime/
+sequence_parallel/ulysses_sp.py:1065,943``), autograd.Function wrappers that
+shard the sequence dim and recompute each shard in backward.
+
+TPU-native design: a ``lax.scan`` over sequence tiles with ``jax.checkpoint``
+on the tile body. Forward materializes one ``[B, tile, V]`` logits block at a
+time (XLA reuses the buffer across scan iterations); backward recomputes each
+tile's logits and accumulates the head/hidden cotangents through the scan —
+the same memory shape as the reference's shard-by-shard ``torch.autograd.grad``
+loop, but compiled as one XLA program instead of a Python loop over shards.
+
+Composes with Ulysses/ring sequence parallelism (``S`` here is the local
+sequence shard) and with the GAS microbatch scan in the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pad_seq(x: jnp.ndarray, tile_size: int, pad_value=0):
+    """Pad dim 1 (sequence) up to a multiple of tile_size."""
+    pad = (-x.shape[1]) % tile_size
+    if pad == 0:
+        return x, 0
+    widths = [(0, 0)] * x.ndim
+    widths[1] = (0, pad)
+    return jnp.pad(x, widths, constant_values=pad_value), pad
+
+
+def _to_tiles(x: jnp.ndarray, tile_size: int) -> jnp.ndarray:
+    """[B, S, ...] -> [S/tile, B, tile, ...] (scan axis leading)."""
+    b, s = x.shape[:2]
+    n = s // tile_size
+    return x.reshape((b, n, tile_size) + x.shape[2:]).swapaxes(0, 1)
+
+
+def tiled_causal_lm_loss(
+    hidden: jnp.ndarray,
+    head: jnp.ndarray,
+    input_ids: jnp.ndarray | None = None,
+    labels: jnp.ndarray | None = None,
+    *,
+    ignore_index: int = -100,
+    z_loss: float = 0.0,
+    tile_size: int = 1024,
+) -> jnp.ndarray:
+    """Next-token cross entropy without materializing ``[B, S, V]`` logits.
+
+    Numerically equivalent to ``causal_lm_loss(hidden @ head, input_ids,
+    labels)`` (``models/api.py``): fp32 log-softmax, ignore_index masking,
+    mean over unmasked targets, optional z-loss. ``hidden`` is the final
+    (post-norm) hidden state ``[B, S, D]``; ``head`` the ``[D, V]`` projection.
+    """
+    b, s, _ = hidden.shape
+    if labels is None:
+        if input_ids is None:
+            raise ValueError("tiled_causal_lm_loss needs input_ids or labels")
+        # shift left; final position has no target (masked via ignore_index)
+        targets = jnp.concatenate(
+            [input_ids[:, 1:], jnp.full((b, 1), ignore_index, input_ids.dtype)], axis=1
+        )
+    else:
+        targets = labels
+
+    hidden, _ = _pad_seq(hidden, tile_size)
+    targets, _ = _pad_seq(targets, tile_size, pad_value=ignore_index)
+    xt = _to_tiles(hidden, tile_size)
+    tt = _to_tiles(targets, tile_size)
+
+    def tile_body(carry, xs_ts):
+        xs, ts = xs_ts
+        logits = (xs @ head.astype(xs.dtype)).astype(jnp.float32)
+        mask = (ts != ignore_index).astype(jnp.float32)
+        safe = jnp.where(ts == ignore_index, 0, ts)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        true_logit = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        nll_sum, z_sum, cnt = carry
+        nll_sum = nll_sum + ((logz - true_logit) * mask).sum()
+        z_sum = z_sum + ((logz * mask) ** 2).sum()
+        cnt = cnt + mask.sum()
+        return (nll_sum, z_sum, cnt), None
+
+    zero = jnp.float32(0.0)
+    (nll_sum, z_sum, cnt), _ = lax.scan(
+        jax.checkpoint(tile_body), (zero, zero, zero), (xt, tt)
+    )
+    denom = jnp.maximum(cnt, 1.0)
+    loss = nll_sum / denom
+    if z_loss > 0.0:
+        loss = loss + z_loss * z_sum / denom
+    return loss
+
+
+def tiled_apply(
+    fn: Callable[[jnp.ndarray], jnp.ndarray],
+    x: jnp.ndarray,
+    tile_size: int,
+) -> jnp.ndarray:
+    """Apply a token-local function over sequence tiles with per-tile remat
+    (reference ``TiledMLP``, ``ulysses_sp.py:943``).
+
+    ``fn`` must act independently per token position (MLPs, norms,
+    projections — not attention). Forward peak shrinks from ``[B, S, F]``
+    intermediates to ``[B, tile, F]``; backward recomputes per tile.
+    """
+    b, s = x.shape[:2]
+    xp, pad = _pad_seq(x, tile_size)
+    xt = _to_tiles(xp, tile_size)
+
+    def tile_body(carry, xs):
+        return carry, fn(xs)
+
+    _, yt = lax.scan(jax.checkpoint(tile_body), None, xt)
+    y = yt.swapaxes(0, 1).reshape((b, s + pad) + yt.shape[3:])
+    return y[:, :s] if pad else y
+
+
+# reference-parity alias (TiledMLP is tiled_apply over the MLP body)
+tiled_mlp = tiled_apply

@@ -91,12 +91,15 @@ class Engine:
     ):
         self.config = config
         self.topo = topo
+        sp_cfg = config.sequence_parallel
         self.shard_ctx = ShardCtx(
             mesh=topo.mesh,
-            sp_mode=config.sequence_parallel.mode,
+            sp_mode=sp_cfg.mode,
             pp_microbatches=config.pipeline.num_microbatches,
             remat=config.activation_checkpointing.enabled,
             remat_policy=_resolve_remat_policy(config.activation_checkpointing.policy),
+            loss_tile_size=sp_cfg.tile_size if sp_cfg.tiled_logits else 0,
+            mlp_tile_size=sp_cfg.tile_size if sp_cfg.tiled_mlp else 0,
         )
         self.model_spec = model(self.shard_ctx) if callable(model) else model
         self.training_dataloader = training_data

@@ -12,7 +12,14 @@ import sys
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+    _flags += " --xla_force_host_platform_device_count=8"
+# on a 1-core box the 8 simulated device threads time-slice one CPU; XLA's
+# 40s collective-rendezvous termination timeout aborts the process under
+# heavy compute (bf16 emulation) — effectively disable it
+if "collective_call_terminate_timeout" not in _flags:
+    _flags += (" --xla_cpu_collective_call_warn_stuck_seconds=120"
+               " --xla_cpu_collective_call_terminate_timeout_seconds=3600")
+os.environ["XLA_FLAGS"] = _flags
 os.environ["DSTPU_ACCELERATOR"] = "cpu"
 
 # jax may already be preloaded (TPU-tunnel .pth hook) with JAX_PLATFORMS=axon;
@@ -20,6 +27,13 @@ os.environ["DSTPU_ACCELERATOR"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# persistent compilation cache: repeat runs of the suite skip XLA recompiles
+# (the dominant cost — every engine test jits a full train step)
+_cache_dir = os.environ.get("DSTPU_TEST_JIT_CACHE", "/tmp/dstpu_jit_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,212 @@
+"""ALST sequence tiling: tiled logits loss + tiled MLP.
+
+Reference behavior matched: ``deepspeed/runtime/sequence_parallel/
+ulysses_sp.py:1065 TiledFusedLogitsLoss`` / ``:943 TiledMLP`` — identical
+numerics to the untiled path, sub-linear loss-head memory in sequence length.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.api import causal_lm_loss
+from deepspeed_tpu.parallel.sequence_tiling import (
+    tiled_apply,
+    tiled_causal_lm_loss,
+)
+
+
+def _random_case(b=2, s=48, d=16, v=97, seed=0):
+    k = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(k, 3)
+    hidden = jax.random.normal(k1, (b, s, d), jnp.float32)
+    head = jax.random.normal(k2, (d, v), jnp.float32) * 0.1
+    ids = jax.random.randint(k3, (b, s), 0, v)
+    return hidden, head, ids
+
+
+class TestTiledLoss:
+    @pytest.mark.parametrize("tile", [16, 48, 64])  # divides, equals, exceeds S
+    def test_matches_untiled(self, tile):
+        hidden, head, ids = _random_case()
+        ref = causal_lm_loss(hidden @ head, ids)
+        got = tiled_causal_lm_loss(hidden, head, ids, tile_size=tile)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+    def test_labels_ignore_index_and_zloss(self):
+        hidden, head, ids = _random_case()
+        labels = np.array(ids)  # writable copy
+        labels[:, ::3] = -100  # mask a third of positions
+        labels = jnp.asarray(labels)
+        ref = causal_lm_loss(hidden @ head, ids, labels=labels, z_loss=1e-3)
+        got = tiled_causal_lm_loss(hidden, head, ids, labels=labels,
+                                   z_loss=1e-3, tile_size=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+    def test_grads_match(self):
+        hidden, head, ids = _random_case(s=32)
+
+        ref_g = jax.grad(
+            lambda h, w: causal_lm_loss(h @ w, ids), argnums=(0, 1)
+        )(hidden, head)
+        got_g = jax.grad(
+            lambda h, w: tiled_causal_lm_loss(h, w, ids, tile_size=8), argnums=(0, 1)
+        )(hidden, head)
+        for r, g in zip(ref_g, got_g):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=2e-4, atol=1e-6)
+
+    def test_loss_head_memory_sublinear(self):
+        """Compiled temp memory of the tiled loss must stay far below the
+        full [B, S, V] logits block the untiled path materializes."""
+        b, s, d, v, tile = 1, 1 << 14, 32, 2048, 512
+        hidden = jnp.zeros((b, s, d), jnp.float32)
+        head = jnp.zeros((d, v), jnp.float32)
+        ids = jnp.zeros((b, s), jnp.int32)
+
+        untiled = jax.jit(
+            jax.grad(lambda h, w: causal_lm_loss(h @ w, ids), argnums=(0, 1))
+        ).lower(hidden, head).compile()
+        tiled = jax.jit(
+            jax.grad(lambda h, w: tiled_causal_lm_loss(h, w, ids, tile_size=tile),
+                     argnums=(0, 1))
+        ).lower(hidden, head).compile()
+
+        logits_bytes = b * s * v * 4
+        untiled_temp = untiled.memory_analysis().temp_size_in_bytes
+        tiled_temp = tiled.memory_analysis().temp_size_in_bytes
+        assert untiled_temp >= logits_bytes
+        assert tiled_temp < logits_bytes // 4, (
+            f"tiled loss temp {tiled_temp} not sub-linear (logits {logits_bytes})"
+        )
+
+
+class TestTiledApply:
+    def test_matches_direct(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 40, 8))
+        w = jax.random.normal(jax.random.PRNGKey(1), (8, 24))
+
+        def fn(t):
+            return jax.nn.gelu(t @ w)
+
+        np.testing.assert_allclose(
+            np.asarray(tiled_apply(fn, x, 16)), np.asarray(fn(x)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_grad_matches(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 8))
+        w = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+
+        def loss_direct(w_):
+            return jnp.sum(jnp.tanh(x @ w_) ** 2)
+
+        def loss_tiled(w_):
+            return jnp.sum(tiled_apply(lambda t: jnp.tanh(t @ w_), x, 8) ** 2)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(loss_tiled)(w)),
+            np.asarray(jax.grad(loss_direct)(w)),
+            rtol=1e-4, atol=1e-6,
+        )
+
+
+class TestEngineIntegration:
+    def test_long_context_train_step(self):
+        """Multi-thousand-token train step executes end-to-end on the 8-device
+        CPU mesh: ring (context-parallel) attention + tiled loss + tiled MLP,
+        finite loss. (Longer execution is out of reach for this 1-core CPU box
+        — bf16 is emulated; the 128K memory claim is proven by compile-time
+        analysis in test_128k_step_fits_memory_budget.)"""
+        import deepspeed_tpu
+        from deepspeed_tpu.models import llama
+
+        seq = 1 << 12  # 4096 tokens, 512 per device
+        cfg = llama.LlamaConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=8, num_kv_heads=8, max_seq_len=seq)
+        config = {
+            "train_micro_batch_size_per_device": 1,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 0,
+            "sequence_length": seq,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"sequence": 8},
+            "sequence_parallel": {"mode": "ring", "tiled_logits": True,
+                                  "tiled_mlp": True, "tile_size": 2048},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=lambda ctx: llama.build(cfg, ctx=ctx), config=config)
+        ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, seq), np.int32)
+        loss = float(engine.train_batch({"input_ids": ids}))
+        assert np.isfinite(loss)
+
+    def test_128k_step_fits_memory_budget(self):
+        """Compile (not run) a full 128K-token train step over the 8-device
+        mesh with ring attention + ALST tiling and bound its per-device temp
+        memory. The untiled loss path provably exceeds the budget: its
+        [1, 128K, 32768] fp32 logits alone are 17 GB (> 4 GB budget) before
+        counting the backward's second copy; the tiled step's entire compiled
+        temp footprint must come in under the budget.
+        """
+        import deepspeed_tpu
+        from deepspeed_tpu.models import llama
+
+        seq = 1 << 17  # 131072 tokens
+        vocab = 32768
+        cfg = llama.LlamaConfig(
+            vocab_size=vocab, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=1, num_kv_heads=1, head_dim=64,
+            max_seq_len=seq)
+        config = {
+            "train_micro_batch_size_per_device": 1,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 0,
+            "sequence_length": seq,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"sequence": 8},
+            "sequence_parallel": {"mode": "ring", "tiled_logits": True,
+                                  "tiled_mlp": True, "tile_size": 2048},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=lambda ctx: llama.build(cfg, ctx=ctx), config=config)
+        fn = engine._build_train_batch_fn()
+        ids = np.zeros((1, seq), np.int32)
+        batch = engine._put_gas_batch({"input_ids": ids})
+        compiled = fn.lower(
+            engine.params, engine.opt_state, engine.scale_state,
+            jnp.int32(0), engine._rng, batch,
+        ).compile()
+        budget = 4 << 30
+        untiled_logits_bytes = 1 * seq * vocab * 4
+        assert untiled_logits_bytes > budget  # what the untiled path would need
+        temp = compiled.memory_analysis().temp_size_in_bytes
+        assert temp < budget, f"128K tiled step temp {temp/2**30:.2f} GiB > budget"
+
+    def test_tiled_config_matches_untiled_loss(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.comm.topology import reset_topology
+        from deepspeed_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(512)
+        ids = np.random.default_rng(1).integers(0, 512, (4, 64), np.int32)
+        losses = {}
+        for tiled in (False, True):
+            reset_topology()
+            config = {
+                "train_micro_batch_size_per_device": 4,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 0,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "mesh": {"data": 1},
+                "sequence_parallel": {"tiled_logits": tiled, "tiled_mlp": tiled,
+                                      "tile_size": 16},
+            }
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=lambda ctx: llama.build(cfg, ctx=ctx), config=config,
+                mesh_devices=jax.devices()[:1])
+            losses[tiled] = float(engine.train_batch({"input_ids": ids}))
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
