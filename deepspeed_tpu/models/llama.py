@@ -133,7 +133,10 @@ def _decoder_layer(cfg: LlamaConfig, ctx: ShardCtx, attn_impl: str,
 
         def mlp_fn(xs):
             hs = rmsnorm(xs, lp["mlp_norm"], cfg.rms_norm_eps)
-            return (jax.nn.silu(hs @ lp["w_gate"]) * (hs @ lp["w_up"])) @ lp["w_down"]
+            gate = ctx.constrain(jax.nn.silu(hs @ lp["w_gate"]),
+                                 "batch", "seq", "ffn_act")
+            up = ctx.constrain(hs @ lp["w_up"], "batch", "seq", "ffn_act")
+            return (gate * up) @ lp["w_down"]
 
         x = x + tiled_mlp(mlp_fn, x, ctx.mlp_tile_size)
     else:
@@ -178,9 +181,9 @@ def forward(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
 
 # ------------------------------------------------------------------ inference
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> dict:
-    """Per-layer KV cache, stacked [L, B, max_len, Hkv, Dh] (the TPU analog of
-    the reference inference KV workspace, ``inference/v2/ragged/kv_cache.py``
-    — blocked/paged variant lives in ``inference/kv_cache.py``)."""
+    """Per-layer KV cache, stacked [L, B, max_len, Hkv, Dh] — the dense
+    fixed-shape cache of the v1-style engine (the TPU analog of the reference
+    inference KV workspace)."""
     shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.hd)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 

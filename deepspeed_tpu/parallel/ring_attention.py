@@ -2,16 +2,28 @@
 
 The reference has NO ring/context-parallel implementation (SURVEY.md §5.7) —
 its long-context answer is Ulysses all-to-all plus chunked/offloaded attention
-(FPDT). On TPU, ring attention over an ICI ring is the idiomatic counterpart:
-KV shards rotate around the ``sequence`` axis with ``ppermute`` while each rank
-accumulates blockwise-softmax partial attention for its local queries — comm is
-fully overlappable with the block compute, and per-device memory stays
-O(S/P). Offered as ``sequence_parallel.mode = "ring"``.
+(FPDT, ``/root/reference/deepspeed/sequence/fpdt_layer.py:545`` — chunked
+online-softmax with recompute, the memory behavior matched here). On TPU, ring
+attention over an ICI ring is the idiomatic counterpart: KV shards rotate
+around the ``sequence`` axis with ``ppermute`` while each rank accumulates
+blockwise-softmax partial attention for its local queries — comm is fully
+overlappable with the block compute, and per-device memory stays O(S/P).
+Offered as ``sequence_parallel.mode = "ring"``.
 
 Implementation: ``shard_map`` over the sequence axis; fp32 online-softmax
 accumulation (same math as flash attention's outer loop, with the KV loop
 distributed). Causality is enforced by global-position masking, so the result
 is exact vs. single-device causal attention.
+
+Memory: the op carries a **custom VJP**. Autodiff through the forward scan
+would save every ring step's ``[S_loc, S_loc]`` score block (O(S_loc²·n)
+backward memory — the exact quadratic blow-up flash attention exists to
+avoid). Instead the forward saves only ``(q, k, v, o, lse)`` — O(S_loc·d) —
+and the backward re-runs the ring, recomputing each block's probabilities
+from the saved log-sum-exp while dk/dv accumulators travel around the ring
+with their KV block. Within each ring step the query dimension is processed
+in fixed-size chunks (an inner ``lax.scan``) so transient score blocks are
+``[chunk, S_loc]``, never ``[S_loc, S_loc]``.
 """
 
 from __future__ import annotations
@@ -24,54 +36,194 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.comm.topology import AXIS_SEQ, batch_spec_entry
+from deepspeed_tpu.comm.topology import AXIS_SEQ
 from deepspeed_tpu.ops.attention import repeat_kv
+from deepspeed_tpu.parallel.sequence_tiling import (
+    _from_tiles as _unchunk_seq,
+    _to_tiles,
+)
 
 _NEG_INF = -1e30
+_MAX_Q_CHUNK = 2048
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale):
-    """Runs inside shard_map: q/k/v are local seq shards [B, S_loc, H, D]."""
+def _pick_chunk(s_loc: int) -> int:
+    """Largest divisor of s_loc not exceeding _MAX_Q_CHUNK."""
+    c = min(s_loc, _MAX_Q_CHUNK)
+    while s_loc % c:
+        c -= 1
+    return c
+
+
+def _chunk_seq(x, c):
+    """[b, s, ...] -> [nc, b, c, ...] (chunk axis leading, for scan)."""
+    return _to_tiles(x, c)
+
+
+def _chunk_rows(x, c):
+    """[b, h, s] -> [nc, b, h, c]"""
+    b, h, s = x.shape
+    return x.reshape(b, h, s // c, c).transpose(2, 0, 1, 3)
+
+
+def _unchunk_rows(x):
+    """[nc, b, h, c] -> [b, h, s]"""
+    nc, b, h, c = x.shape
+    return x.transpose(1, 2, 0, 3).reshape(b, h, nc * c)
+
+
+def _rotate(x, axis_name, n):
+    return lax.ppermute(x, axis_name, [(j, (j + 1) % n) for j in range(n)])
+
+
+def _ring_fwd_compute(q, k, v, axis_name: str, causal: bool, scale):
+    """Online-softmax ring forward. Returns (o [b,s,h,d] in q.dtype, lse [b,h,s] fp32)."""
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    c = _pick_chunk(s_loc)
+    nc = s_loc // c
 
-    qf = (q * scale).astype(jnp.float32)
-    q_pos = my * s_loc + jnp.arange(s_loc)  # global positions of local queries
+    qf = _chunk_seq((q * scale).astype(jnp.float32), c)  # [nc,b,c,h,d]
+    pos_c = jnp.arange(s_loc).reshape(nc, c)  # local q positions per chunk
 
-    # accumulator state: running max m, denom l, weighted sum o (all fp32)
-    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
-    m0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    o0 = jnp.zeros((nc, b, c, h, d), jnp.float32)
+    m0 = jnp.full((nc, b, h, c), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((nc, b, h, c), jnp.float32)
 
-    def step(carry, i):
+    def ring_step(carry, i):
         o_acc, m_acc, l_acc, k_cur, v_cur = carry
         src = (my - i) % n  # which global KV block we currently hold
         k_pos = src * s_loc + jnp.arange(s_loc)
+        kf = k_cur.astype(jnp.float32)
+        vf = v_cur.astype(jnp.float32)
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32))
+        def chunk_step(_, xs):
+            qc, oc, mc, lc, pc = xs
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qc, kf)
+            if causal:
+                q_pos = my * s_loc + pc
+                mask = q_pos[:, None] >= k_pos[None, :]
+                scores = jnp.where(mask[None, None], scores, _NEG_INF)
+            m_blk = jnp.max(scores, axis=-1)
+            m_new = jnp.maximum(mc, m_blk)
+            p = jnp.exp(scores - m_new[..., None])
+            corr = jnp.exp(mc - m_new)
+            l_new = lc * corr + jnp.sum(p, axis=-1)
+            o_new = (oc * corr.transpose(0, 2, 1)[..., None]
+                     + jnp.einsum("bhqk,bkhd->bqhd", p, vf))
+            return None, (o_new, m_new, l_new)
+
+        def compute(ops):
+            o_a, m_a, l_a = ops
+            _, out = lax.scan(chunk_step, None, (qf, o_a, m_a, l_a, pos_c))
+            return out
+
         if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+            # blocks strictly in the future of every local query are fully
+            # masked — skip their compute, just rotate
+            o_acc, m_acc, l_acc = lax.cond(
+                src <= my, compute, lambda ops: ops, (o_acc, m_acc, l_acc)
+            )
+        else:
+            o_acc, m_acc, l_acc = compute((o_acc, m_acc, l_acc))
 
-        m_blk = jnp.max(scores, axis=-1)
-        m_new = jnp.maximum(m_acc, m_blk)
-        # guard fully-masked rows (m_new == -inf): exp(_NEG_INF - _NEG_INF) -> use safe sub
-        p = jnp.exp(scores - m_new[..., None])
-        corr = jnp.exp(m_acc - m_new)
-        l_new = l_acc * corr + jnp.sum(p, axis=-1)
-        o_blk = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
-        o_new = o_acc * corr.transpose(0, 2, 1)[..., None] + o_blk
+        return (o_acc, m_acc, l_acc,
+                _rotate(k_cur, axis_name, n), _rotate(v_cur, axis_name, n)), None
 
-        perm = [(j, (j + 1) % n) for j in range(n)]
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_next, v_next), None
+    (o, m, l, _, _), _ = lax.scan(ring_step, (o0, m0, l0, k, v), jnp.arange(n))
+    denom = jnp.maximum(l, 1e-30)  # [nc,b,h,c]
+    lse = m + jnp.log(denom)
+    o = o / denom.transpose(0, 1, 3, 2)[..., None]
+    return _unchunk_seq(o).astype(q.dtype), _unchunk_rows(lse)
 
-    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
-    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return (o / denom).astype(q.dtype)
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale):
+    """Runs inside shard_map: q/k/v are local seq shards [B, S_loc, H, D]."""
+    o, _ = _ring_fwd_compute(q, k, v, axis_name, causal, scale)
+    return o
+
+
+def _ring_fwd_rule(q, k, v, axis_name, causal, scale):
+    o, lse = _ring_fwd_compute(q, k, v, axis_name, causal, scale)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd_rule(axis_name, causal, scale, res, do):
+    q, k, v, o, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    c = _pick_chunk(s_loc)
+    nc = s_loc // c
+
+    qf = _chunk_seq((q * scale).astype(jnp.float32), c)  # [nc,b,c,h,d]
+    do_c = _chunk_seq(do.astype(jnp.float32), c)
+    # delta_i = sum_d do_i * o_i  (rescaling term of the softmax backward)
+    delta = _chunk_rows(
+        jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32), o.astype(jnp.float32)), c
+    )  # [nc,b,h,c]
+    lse_c = _chunk_rows(lse, c)
+    pos_c = jnp.arange(s_loc).reshape(nc, c)
+
+    dq0 = jnp.zeros((nc, b, c, h, d), jnp.float32)
+    dk0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    dv0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+
+    def ring_step(carry, i):
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (my - i) % n
+        k_pos = src * s_loc + jnp.arange(s_loc)
+        kf = k_cur.astype(jnp.float32)
+        vf = v_cur.astype(jnp.float32)
+
+        def chunk_step(carry2, xs):
+            dk_a, dv_a = carry2
+            qc, dqc, doc, deltac, lsec, pc = xs
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qc, kf)
+            if causal:
+                q_pos = my * s_loc + pc
+                mask = q_pos[:, None] >= k_pos[None, :]
+                scores = jnp.where(mask[None, None], scores, _NEG_INF)
+            # recompute probabilities from the saved global log-sum-exp;
+            # masked entries underflow to exactly 0
+            p = jnp.exp(scores - lsec[..., None])  # [b,h,c,S_loc]
+            dv_a = dv_a + jnp.einsum("bhqk,bqhd->bkhd", p, doc)
+            dp = jnp.einsum("bqhd,bkhd->bhqk", doc, vf)
+            ds = p * (dp - deltac[..., None])
+            dq_new = dqc + jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
+            # qc is pre-scaled, so ds^T @ qc already carries the scale factor
+            dk_a = dk_a + jnp.einsum("bhqk,bqhd->bkhd", ds, qc)
+            return (dk_a, dv_a), dq_new
+
+        def compute(ops):
+            dq_a, dk_a, dv_a = ops
+            (dk_n, dv_n), dq_n = lax.scan(
+                chunk_step, (dk_a, dv_a), (qf, dq_a, do_c, delta, lse_c, pos_c)
+            )
+            return dq_n, dk_n, dv_n
+
+        if causal:
+            dq_acc, dk_cur, dv_cur = lax.cond(
+                src <= my, compute, lambda ops: ops, (dq_acc, dk_cur, dv_cur)
+            )
+        else:
+            dq_acc, dk_cur, dv_cur = compute((dq_acc, dk_cur, dv_cur))
+
+        # dk/dv accumulators travel with their KV block; after n rotations the
+        # block (and its fully-accumulated gradient) is back at its owner
+        return (dq_acc,
+                _rotate(k_cur, axis_name, n), _rotate(v_cur, axis_name, n),
+                _rotate(dk_cur, axis_name, n), _rotate(dv_cur, axis_name, n)), None
+
+    (dq, _, _, dk, dv), _ = lax.scan(ring_step, (dq0, k, v, dk0, dv0), jnp.arange(n))
+    return (_unchunk_seq(dq).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_ring_attention_local.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
 def ring_attention(q, k, v, mesh, causal: bool = True, scale=None):
@@ -85,8 +237,9 @@ def ring_attention(q, k, v, mesh, causal: bool = True, scale=None):
     v = repeat_kv(v, q.shape[2] // v.shape[2])
 
     spec = P(None, AXIS_SEQ, None, None)
-    fn = functools.partial(_ring_attention_local, axis_name=AXIS_SEQ,
-                           causal=causal, scale=scale)
+
+    def fn(q, k, v):  # custom_vjp nondiff args must be positional
+        return _ring_attention_local(q, k, v, AXIS_SEQ, causal, scale)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={AXIS_SEQ},
                          check_vma=False)(q, k, v)

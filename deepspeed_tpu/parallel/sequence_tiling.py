@@ -44,6 +44,12 @@ def _to_tiles(x: jnp.ndarray, tile_size: int) -> jnp.ndarray:
     return x.reshape((b, n, tile_size) + x.shape[2:]).swapaxes(0, 1)
 
 
+def _from_tiles(x: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`_to_tiles`: [N, B, tile, ...] -> [B, N*tile, ...]."""
+    n, b, t = x.shape[:3]
+    return x.swapaxes(0, 1).reshape((b, n * t) + x.shape[3:])
+
+
 def tiled_causal_lm_loss(
     hidden: jnp.ndarray,
     head: jnp.ndarray,

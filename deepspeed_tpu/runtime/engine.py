@@ -160,7 +160,18 @@ class Engine:
         self._skip_base = 0              # skips restored from checkpoint
         self._skip_dev = jnp.int32(0)    # async device-side skip accumulator
         self._last_metrics: dict = {}
-        self._rng = jax.random.PRNGKey(seed + 1)
+        # two independent rng streams: the train stream is a frozen base key
+        # (per-step keys derived by fold_in, never mutated) so interleaving
+        # eval/backward calls — which consume _next_rng() — cannot perturb the
+        # training trajectory or break resume-reproducibility
+        self._train_rng = jax.random.PRNGKey(seed + 1)
+        self._rng = jax.random.PRNGKey(seed + 2)
+        # bound the async dispatch pipeline: block on the step that ran
+        # _max_inflight steps ago so the host can't run unboundedly ahead on
+        # backends without bounded dispatch queues (errors surface within a
+        # bounded window; throughput still overlaps across the window)
+        self._max_inflight = 8
+        self._inflight: list = []
 
         # ---- grad accumulation buffer for the fwd/bwd parity path
         self._acc_grads = None
@@ -364,13 +375,19 @@ class Engine:
             self.opt_state,
             self.scale_state,
             jnp.int32(self.global_steps),
-            self._rng,
+            self._train_rng,
             dev_batch,
         )
         # NO per-step device sync here: over a tunneled TPU each host<->device
         # round trip costs more than the update tail; steps pipeline and Python
         # overhead hides under device compute. _after_step syncs only when a
         # consumer (monitor / steps_per_print / fp16 bookkeeping) needs values.
+        # A bounded in-flight window (block on the step from _max_inflight ago)
+        # keeps the host from running unboundedly ahead; per-step wall times are
+        # only accurate at settle points (steps_per_print / window boundary).
+        self._inflight.append(metrics["loss"])
+        if len(self._inflight) > self._max_inflight:
+            jax.block_until_ready(self._inflight.pop(0))
         self.tput_timer.stop(global_step=True)
         self._after_step(metrics)
         self.micro_steps += self.gas

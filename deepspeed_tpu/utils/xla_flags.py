@@ -1,0 +1,141 @@
+"""Runtime probing of optional XLA_FLAGS.
+
+Some environments preload a PJRT plugin (e.g. a TPU tunnel) whose shared
+library parses ``XLA_FLAGS`` with its *own* flag registry — typically built
+against an older XLA than the installed jaxlib.  ``parse_flags_from_env.cc``
+F-aborts the whole process on any flag unknown to that registry, so a flag
+that is perfectly valid for jaxlib can still be fatal.  The only safe way to
+use optional flags is to probe them in a throwaway subprocess and adopt only
+what survives.
+
+Mirrors the capability-probe philosophy of the reference's accelerator
+selection (``/root/reference/accelerator/real_accelerator.py:51``) applied to
+XLA flags instead of device backends.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_PROBE_SNIPPET = (
+    "import jax; jax.config.update('jax_platforms', 'cpu'); jax.devices()"
+)
+
+# parse_flags_from_env.cc's F-abort message — the one *definitive* rejection
+# signal.  Anything else (timeout, import crash, OSError) may be transient and
+# must not be cached.
+_REJECT_MARKER = b"Unknown flag"
+
+
+def _cache_path(key: str) -> str:
+    return os.path.join(
+        tempfile.gettempdir(), f"dstpu_xla_flag_probe_{key}.json"
+    )
+
+
+def probe_extra_xla_flags(
+    candidates: list[str],
+    base_flags: str = "",
+    timeout: float = 120.0,
+    use_cache: bool = True,
+    env_overrides: dict[str, str | None] | None = None,
+) -> list[str]:
+    """Return the subset of ``candidates`` this environment's XLA flag parsers accept.
+
+    Spawns ``python -c "import jax; jax.devices()"`` with
+    ``XLA_FLAGS = base_flags + candidates``; on a clean exit all candidates are
+    adopted.  Candidates already present in ``base_flags`` are skipped (the
+    caller/user set them explicitly — don't second-guess or duplicate them).
+    Only *definitive* verdicts are cached on disk: a clean exit, or a child
+    that died printing ``Unknown flag``.  Transient failures (timeout, import
+    crash) adopt nothing but leave the cache alone so the next run re-probes.
+
+    ``env_overrides`` lets the caller make the probe child's environment match
+    the real child it is probing on behalf of (value ``None`` = unset).
+    """
+    base_names = {f.split("=", 1)[0] for f in base_flags.split()}
+    candidates = [
+        c for c in candidates if c and c.split("=", 1)[0] not in base_names
+    ]
+    if not candidates:
+        return []
+
+    try:
+        import jax
+
+        jax_ver = jax.__version__
+    except Exception:  # pragma: no cover - jax is a hard dep everywhere else
+        jax_ver = "unknown"
+
+    # Key on what determines acceptance: the candidate set, the flag-parser
+    # registries in play (proxied by interpreter + jax version), and the env
+    # overrides (they change which PJRT plugins load, hence which registries
+    # parse the flags).  base_flags is deliberately excluded — acceptance of a
+    # flag doesn't depend on which other valid flags accompany it, and
+    # including it would fragment the cache across e.g. different
+    # --xla_force_host_platform_device_count values.
+    key_src = json.dumps(
+        [sorted(candidates), sys.executable, jax_ver,
+         sorted((env_overrides or {}).items(), key=str)]
+    )
+    key = hashlib.sha256(key_src.encode()).hexdigest()[:16]
+    cache = _cache_path(key)
+    if use_cache and os.path.exists(cache):
+        try:
+            with open(cache) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            pass
+
+    def _probe(flags: list[str]) -> str:
+        """-> 'ok' | 'rejected' | 'transient'"""
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (base_flags + " " + " ".join(flags)).strip()
+        env.pop("PYTEST_CURRENT_TEST", None)
+        for k, v in (env_overrides or {}).items():
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                env=env,
+                capture_output=True,
+                timeout=timeout,
+            )
+        except (subprocess.TimeoutExpired, OSError):
+            return "transient"
+        if proc.returncode == 0:
+            return "ok"
+        if _REJECT_MARKER in proc.stderr or _REJECT_MARKER in proc.stdout:
+            return "rejected"
+        return "transient"
+
+    verdict = _probe(candidates)
+    definitive = verdict != "transient"
+    if verdict == "ok":
+        accepted = list(candidates)
+    elif verdict == "rejected" and len(candidates) > 1:
+        accepted = []
+        for c in candidates:
+            v = _probe([c])
+            if v == "ok":
+                accepted.append(c)
+            elif v == "transient":
+                definitive = False
+    else:
+        accepted = []
+
+    if use_cache and definitive:
+        try:
+            with open(cache, "w") as f:
+                json.dump(accepted, f)
+        except OSError:
+            pass
+    return accepted

@@ -10,15 +10,29 @@ through the CPU backend. Must be set before jax initializes its backend.
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     _flags += " --xla_force_host_platform_device_count=8"
-# on a 1-core box the 8 simulated device threads time-slice one CPU; XLA's
-# 40s collective-rendezvous termination timeout aborts the process under
-# heavy compute (bf16 emulation) — effectively disable it
-if "collective_call_terminate_timeout" not in _flags:
-    _flags += (" --xla_cpu_collective_call_warn_stuck_seconds=120"
-               " --xla_cpu_collective_call_terminate_timeout_seconds=3600")
+
+# On a 1-core box the 8 simulated device threads time-slice one CPU and XLA's
+# collective-rendezvous watchdog can abort heavy tests.  The flags that relax
+# it are NOT safe to hardcode: preloaded PJRT plugins (TPU tunnel) parse
+# XLA_FLAGS with their own registry and F-abort on flags unknown to them.
+# Probe in a subprocess and adopt only what this environment accepts.
+from deepspeed_tpu.utils.xla_flags import probe_extra_xla_flags  # noqa: E402
+
+_flags += "".join(
+    " " + f
+    for f in probe_extra_xla_flags(
+        [
+            "--xla_cpu_collective_call_warn_stuck_seconds=120",
+            "--xla_cpu_collective_call_terminate_timeout_seconds=3600",
+        ],
+        base_flags=_flags,
+    )
+)
 os.environ["XLA_FLAGS"] = _flags
 os.environ["DSTPU_ACCELERATOR"] = "cpu"
 
@@ -34,8 +48,6 @@ _cache_dir = os.environ.get("DSTPU_TEST_JIT_CACHE", "/tmp/dstpu_jit_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
