@@ -151,6 +151,12 @@ class ModelSpec:
     # decode_fn(params, tokens, cache, start_pos) -> (logits, cache)
     init_cache_fn: Callable | None = None
     decode_fn: Callable | None = None
+    # ragged/continuous-batching hooks (reference inference/v2):
+    # init_paged_cache_fn(num_blocks, block_size, dtype) -> cache;
+    # ragged_forward_fn(params, tokens, slots, positions, block_tables, cache)
+    #   -> (logits [T, V], cache)
+    init_paged_cache_fn: Callable | None = None
+    ragged_forward_fn: Callable | None = None
 
 
 def causal_lm_loss(

@@ -243,6 +243,92 @@ def decode_forward(cfg: LlamaConfig, params, tokens, cache, start_pos,
     return logits, {"k": new_k, "v": new_v}
 
 
+def init_paged_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
+                     dtype=jnp.bfloat16) -> dict:
+    """Blocked KV pool, stacked [L, num_blocks, block_size, Hkv, Dh] — the
+    paged cache of the ragged engine (reference
+    ``inference/v2/ragged/kv_cache.py`` blocked KV; block 0 is the scratch
+    block padding tokens write into)."""
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots, block_tables):
+    """One decoder layer over a flat ragged token batch.
+
+    ``x`` [T, D] mixes prefill-chunk tokens and decode tokens from different
+    sequences (SplitFuse layout, reference ``inference/v2/ragged``). New KV is
+    scattered into the block pool *before* attention, so intra-chunk causal
+    attention falls out of the position mask with no special casing.
+    """
+    t_tokens, d = x.shape
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    bs = kc.shape[1]
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(t_tokens, hq, hd)
+    kk = (h @ lp["wk"]).reshape(t_tokens, hkv, hd)
+    vv = (h @ lp["wv"]).reshape(t_tokens, hkv, hd)
+    q, kk = apply_rope(q[None], kk[None], positions[None], cfg.rope_theta)
+    q, kk = q[0], kk[0]
+
+    # scatter each token's KV into (block, offset) of its sequence
+    blk = block_tables[slots, positions // bs]  # [T]
+    off = positions % bs
+    kc = kc.at[blk, off].set(kk.astype(kc.dtype))
+    vc = vc.at[blk, off].set(vv.astype(vc.dtype))
+
+    # per-token gather of its sequence's blocked context
+    tables = block_tables[slots]  # [T, max_blocks]
+    ctx_k = kc[tables].reshape(t_tokens, -1, hkv, hd)
+    ctx_v = vc[tables].reshape(t_tokens, -1, hkv, hd)
+    from deepspeed_tpu.ops.attention import repeat_kv
+
+    rep = hq // hkv
+    ctx_k = repeat_kv(ctx_k, rep)
+    ctx_v = repeat_kv(ctx_v, rep)
+
+    k_pos = jnp.arange(ctx_k.shape[1])
+    bias = jnp.where(k_pos[None, :] <= positions[:, None], 0.0, -1e30)  # [T, ctx]
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    scores = (
+        jnp.einsum("thd,tchd->thc", (q * scale).astype(jnp.float32),
+                   ctx_k.astype(jnp.float32))
+        + bias[:, None, :]
+    )
+    p = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("thc,tchd->thd", p, ctx_v.astype(jnp.float32)).astype(x.dtype)
+    x = x + o.reshape(t_tokens, hq * hd) @ lp["wo"]
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+    return x, kc, vc
+
+
+def ragged_forward(cfg: LlamaConfig, params, tokens, slots, positions,
+                   block_tables, cache):
+    """Flat ragged step: ``[T]`` mixed tokens -> (``[T, V]`` logits, cache).
+
+    Each token carries (slot, absolute position); ``block_tables``
+    [max_seqs+1, max_blocks] maps slots to KV pool blocks (row ``max_seqs`` is
+    the all-scratch padding row). One static-shape XLA program serves any mix
+    of prefill chunks and decodes (reference ``inference/v2/engine_v2.py:30``
+    ``put()`` + ``ragged_ops`` kernels).
+    """
+    x = params["embed"][tokens].astype(cache["k"].dtype)
+
+    def body(x, lp_kv):
+        lp, kc, vc = lp_kv
+        x, kc, vc = _ragged_layer(cfg, x, lp, kc, vc, positions, slots, block_tables)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = x @ head.astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def num_params(cfg: LlamaConfig) -> int:
     d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.hd
     per_layer = d * hd * (cfg.num_heads * 2 + cfg.num_kv_heads * 2) + 3 * d * f + 2 * d
@@ -296,4 +382,6 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         flops_per_token=partial(flops_per_token, cfg),
         init_cache_fn=partial(init_cache, cfg),
         decode_fn=partial(decode_forward, cfg, ctx=ctx),
+        init_paged_cache_fn=partial(init_paged_cache, cfg),
+        ragged_forward_fn=partial(ragged_forward, cfg),
     )

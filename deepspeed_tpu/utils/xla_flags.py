@@ -79,8 +79,15 @@ def probe_extra_xla_flags(
     # flag doesn't depend on which other valid flags accompany it, and
     # including it would fragment the cache across e.g. different
     # --xla_force_host_platform_device_count values.
+    # env vars that change which PJRT plugins (and hence flag registries) load
+    plugin_env = {
+        k: os.environ.get(k)
+        for k in ("JAX_PLATFORMS", "JAX_PLATFORM_NAME", "LD_PRELOAD",
+                  "TPU_LIBRARY_PATH", "TPU_NAME", "PJRT_DEVICE")
+    }
     key_src = json.dumps(
         [sorted(candidates), sys.executable, jax_ver,
+         sorted(plugin_env.items(), key=str),
          sorted((env_overrides or {}).items(), key=str)]
     )
     key = hashlib.sha256(key_src.encode()).hexdigest()[:16]
