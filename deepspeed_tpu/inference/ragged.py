@@ -97,6 +97,7 @@ class _SeqState:
     pos: int = 0  # tokens whose KV has been scheduled into the cache
     generated: list[int] = field(default_factory=list)
     blocks: list[int] = field(default_factory=list)
+    reserved_remaining: int = 0  # worst-case blocks reserved but not yet held
     done: bool = False
 
     def token_at(self, p: int) -> int:
@@ -152,6 +153,10 @@ class RaggedInferenceEngine:
             (self.cfg.max_seqs + 1, self.cfg.max_blocks_per_seq), np.int32
         )
         self._free_slots = list(range(self.cfg.max_seqs - 1, -1, -1))
+        # blocks promised to admitted sequences but not yet allocated;
+        # admission reserves worst case (prompt + max_new) so an admitted
+        # sequence can always finish (reference conservative admission)
+        self._reserved = 0
         self._queued: list[_SeqState] = []
         self._running: dict[int, _SeqState] = {}  # slot -> seq
         self._results: dict[Any, _SeqState] = {}
@@ -184,6 +189,8 @@ class RaggedInferenceEngine:
         prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
         total = len(prompt) + max_new_tokens
         if total > self.cfg.max_seq_len:
             raise ValueError(
@@ -200,9 +207,14 @@ class RaggedInferenceEngine:
         return bool(self._queued or self._running)
 
     # ------------------------------------------------------------------ step
+    def _worst_case_blocks(self, seq: _SeqState) -> int:
+        total = len(seq.prompt) + seq.max_new_tokens
+        return -(-total // self.cfg.block_size)
+
     def _ensure_capacity(self, seq: _SeqState, upto: int) -> bool:
         """Grow seq's block table to cover positions [0, upto); False if the
-        pool can't satisfy it right now."""
+        pool can't satisfy it right now. Admitted sequences draw from their
+        admission-time reservation, so this cannot fail for them."""
         need = -(-upto // self.cfg.block_size) - len(seq.blocks)
         if need <= 0:
             return True
@@ -213,10 +225,15 @@ class RaggedInferenceEngine:
         new = self.allocator.allocate(need)
         start = len(seq.blocks)
         seq.blocks.extend(new)
+        drawn = min(seq.reserved_remaining, len(new))
+        seq.reserved_remaining -= drawn
+        self._reserved -= drawn
         self.block_tables[seq.slot, start:start + len(new)] = new
         return True
 
     def _release(self, seq: _SeqState) -> None:
+        self._reserved -= seq.reserved_remaining  # return unused reservation
+        seq.reserved_remaining = 0
         self.allocator.free(seq.blocks)
         seq.blocks = []
         self.block_tables[seq.slot, :] = 0
@@ -259,15 +276,17 @@ class RaggedInferenceEngine:
             n += 1
 
         # 2) admit queued requests while slots + budget remain (their prompt
-        #    chunks are scheduled in pass 3 below)
+        #    chunks are scheduled in pass 3 below); admission reserves the
+        #    request's worst-case block count so admitted work always finishes
         while self._queued and self._free_slots and n < budget:
             seq = self._queued[0]
-            seq.slot = self._free_slots[-1]
-            if not self._ensure_capacity(seq, min(len(seq.prompt), budget - n)):
-                seq.slot = -1
-                break  # pool pressure: retry admission next step
+            worst = self._worst_case_blocks(seq)
+            if worst > self.allocator.free_blocks - self._reserved:
+                break  # pool pressure: retry admission as blocks free up
             self._queued.pop(0)
-            self._free_slots.pop()
+            seq.slot = self._free_slots.pop()
+            seq.reserved_remaining = worst
+            self._reserved += worst
             self._running[seq.slot] = seq
 
         # 3) prefill chunks for running prompts within the remaining budget

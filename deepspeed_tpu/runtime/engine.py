@@ -88,6 +88,7 @@ class Engine:
         topo: MeshTopology,
         training_data: Iterator | None = None,
         seed: int | None = None,
+        initial_params: Any = None,
     ):
         self.config = config
         self.topo = topo
@@ -119,9 +120,20 @@ class Engine:
         # ---- params (fp32 master), placed per plan (reference zero.Init analog)
         seed = seed if seed is not None else config.seed
         init_rng = jax.random.PRNGKey(seed)
-        self.params = jax.jit(
-            self.model_spec.init_fn, out_shardings=self.plan.param_shardings
-        )(init_rng)
+        if initial_params is not None:
+            # pre-loaded weights (e.g. models.hf_ingest): enforce the fp32
+            # master-weight invariant the init_fn path guarantees, then place
+            # under the plan
+            initial_params = jax.tree_util.tree_map(
+                lambda x: x.astype(np.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                initial_params,
+            )
+            self.params = jax.device_put(initial_params, self.plan.param_shardings)
+        else:
+            self.params = jax.jit(
+                self.model_spec.init_fn, out_shardings=self.plan.param_shardings
+            )(init_rng)
 
         # ---- optimizer (lr=1.0; schedule applied inside the step for exact
         # logged-lr == applied-lr, including skipped-step semantics)
@@ -636,6 +648,7 @@ def initialize(
     training_data: Iterator | None = None,
     mesh_devices: list | None = None,
     seed: int | None = None,
+    initial_params: Any = None,
     **_ignored,
 ):
     """Build the engine (reference ``deepspeed.initialize`` ``__init__.py:93``).
@@ -651,5 +664,6 @@ def initialize(
         topo = dist.init_distributed(cfg.mesh, devices=mesh_devices)
     cfg.resolve_batch_sizes(topo.dp_world_size)
     dist.configure(cfg.comms_logger)
-    engine = Engine(model, cfg, topo, training_data=training_data, seed=seed)
+    engine = Engine(model, cfg, topo, training_data=training_data, seed=seed,
+                    initial_params=initial_params)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler

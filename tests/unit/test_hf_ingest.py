@@ -1,0 +1,124 @@
+"""HF checkpoint ingestion: logits parity against real transformers models
+(reference: ``module_inject`` AutoTP/checkpoint-loading test coverage —
+``tests/unit/model_parallelism``, ``tests/unit/inference`` load real HF
+checkpoints and compare outputs)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import gpt2, llama, mixtral
+from deepspeed_tpu.models.hf_ingest import config_from_hf, load_hf_params
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _save_hf(tmp_path, model):
+    d = str(tmp_path / "hf")
+    model.save_pretrained(d, safe_serialization=True)
+    return d
+
+
+def _hf_logits(model, ids):
+    with torch.no_grad():
+        return model(torch.from_numpy(ids).long()).logits.float().numpy()
+
+
+@pytest.fixture
+def ids():
+    return np.random.default_rng(0).integers(0, 64, (2, 16)).astype(np.int32)
+
+
+class TestLlamaIngest:
+    @pytest.mark.parametrize("tied", [False, True])
+    def test_logits_parity(self, tmp_path, ids, tied):
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=97, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, rope_theta=10000.0,
+            tie_word_embeddings=tied,
+        )
+        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        d = _save_hf(tmp_path, hf)
+
+        family, cfg = config_from_hf(d)
+        assert family == "llama" and cfg.num_kv_heads == 2
+        assert cfg.tie_embeddings == tied
+        params, _ = load_hf_params(d)
+        ours = np.asarray(
+            llama.forward(cfg, jax.tree_util.tree_map(jnp.asarray, params),
+                          jnp.asarray(ids))
+        )
+        np.testing.assert_allclose(ours, _hf_logits(hf, ids), rtol=2e-4, atol=2e-4)
+
+    def test_sharded_load_under_plan(self, tmp_path, ids, mesh8):
+        """Leaves go straight onto the mesh under the training plan; forward
+        still matches HF."""
+        from deepspeed_tpu.parallel.partition import plan_sharding
+
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=97, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128,
+        )
+        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        d = _save_hf(tmp_path, hf)
+
+        family, cfg = config_from_hf(d)
+        spec = llama.build(cfg)
+        plan = plan_sharding(
+            spec.param_logical_axes,
+            jax.eval_shape(spec.init_fn, jax.random.PRNGKey(0)),
+            mesh8, zero_stage=3, use_tp=False,
+            dim_units=spec.logical_dim_units,
+        )
+        params, _ = load_hf_params(d, shardings=plan.param_shardings)
+        leaf = params["layers"]["wq"]
+        assert hasattr(leaf, "sharding")  # on device, not numpy
+        ours = np.asarray(llama.forward(cfg, params, jnp.asarray(ids)))
+        np.testing.assert_allclose(ours, _hf_logits(hf, ids), rtol=2e-4, atol=2e-4)
+
+
+class TestGPT2Ingest:
+    def test_logits_parity(self, tmp_path, ids):
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=97, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+        )
+        hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+        d = _save_hf(tmp_path, hf)
+
+        family, cfg = config_from_hf(d)
+        assert family == "gpt2" and cfg.max_seq_len == 64
+        params, _ = load_hf_params(d)
+        ours = np.asarray(
+            gpt2.forward(cfg, jax.tree_util.tree_map(jnp.asarray, params),
+                         jnp.asarray(ids))
+        )
+        np.testing.assert_allclose(ours, _hf_logits(hf, ids), rtol=2e-4, atol=2e-4)
+
+
+class TestMixtralIngest:
+    def test_logits_parity(self, tmp_path, ids):
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=97, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_local_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=128, rope_theta=10000.0,
+        )
+        hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+        d = _save_hf(tmp_path, hf)
+
+        family, cfg = config_from_hf(d)
+        assert family == "mixtral" and cfg.num_experts == 4 and cfg.top_k == 2
+        # dropless capacity so routing matches HF's exact top-k dispatch
+        cfg = dataclasses.replace(cfg, capacity_factor=float(cfg.num_experts))
+        params, _ = load_hf_params(d, family="mixtral", cfg=cfg)
+        ours = np.asarray(
+            mixtral.forward(cfg, jax.tree_util.tree_map(jnp.asarray, params),
+                            jnp.asarray(ids), train=True)
+        )
+        np.testing.assert_allclose(ours, _hf_logits(hf, ids), rtol=3e-4, atol=3e-4)

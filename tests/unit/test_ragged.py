@@ -151,6 +151,26 @@ class TestRaggedEngine:
         with pytest.raises(RuntimeError, match="deadlock"):
             eng.generate_all()
 
+    def test_conservative_admission_completes_oversubscribed_load(self):
+        """Requests whose combined worst case exceeds the pool but which fit
+        sequentially must all complete: admission reserves worst-case blocks,
+        so later requests wait instead of deadlocking mid-decode."""
+        pool = RaggedConfig(
+            max_tokens_per_step=16, max_seqs=3, block_size=4,
+            num_blocks=12, max_blocks_per_seq=8,  # 11 usable blocks
+        )
+        eng = RaggedInferenceEngine(
+            lambda ctx: llama.build(CFG, ctx=ctx), pool,
+            dtype=jnp.float32, seed=0,
+        )
+        r = np.random.default_rng(0)
+        # worst cases: ceil(20/4)=5, ceil(22/4)=6, ceil(17/4)=5 -> 16 > 11
+        for uid, (plen, new) in {"a": (14, 6), "b": (16, 6), "c": (12, 5)}.items():
+            eng.put(uid, r.integers(0, CFG.vocab_size, plen), max_new_tokens=new)
+        out = eng.generate_all()
+        assert sorted(out) == ["a", "b", "c"]
+        assert [len(out[u]) for u in "abc"] == [6, 6, 5]
+
     def test_splitfuse_efficiency_vs_dense_padding(self):
         """Scheduled useful tokens must beat dense pad-to-max batching: the
         dense engine processes batch*max_prompt prefill + batch*max_new decode
