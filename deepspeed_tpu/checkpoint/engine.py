@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import os
 import shutil
-import threading
 from typing import Any
 
 import numpy as np
@@ -27,7 +26,10 @@ from deepspeed_tpu.utils.logging import log_dist
 
 
 class CheckpointEngine:
-    """Synchronous array writer (reference ``TorchCheckpointEngine`` analog)."""
+    """Synchronous array writer for the legacy single-file universal layout
+    (reference ``TorchCheckpointEngine`` analog). The sharded fragment format
+    (``checkpoint/sharded.py``) is the default save path; this engine remains
+    for reading/writing the old layout."""
 
     def save(self, state: dict[str, dict[str, np.ndarray]], ckpt_dir: str) -> None:
         for name, arrays in state.items():
@@ -43,33 +45,6 @@ class CheckpointEngine:
             if os.path.exists(path):
                 out[name] = ser.load_arrays(path)
         return out
-
-    def commit(self, tag: str) -> bool:
-        return True
-
-    def wait(self) -> None:
-        pass
-
-
-class AsyncCheckpointEngine(CheckpointEngine):
-    """Background-thread writer (reference ``decoupled_checkpoint_engine.py``:
-    rank writers off the training critical path). ``save`` snapshots arrays to
-    host (synchronous, cheap) and writes on a worker thread; ``wait`` joins."""
-
-    def __init__(self):
-        self._thread: threading.Thread | None = None
-
-    def save(self, state, ckpt_dir: str) -> None:
-        self.wait()
-        self._thread = threading.Thread(
-            target=super().save, args=(state, ckpt_dir), daemon=True
-        )
-        self._thread.start()
-
-    def wait(self) -> None:
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
 
 
 def latest_tag(save_dir: str) -> str | None:
@@ -100,5 +75,3 @@ def rotate_checkpoints(save_dir: str, keep_n: int) -> None:
         log_dist(f"rotated out checkpoint {d}", ranks=[0])
 
 
-def get_checkpoint_engine(async_save: bool) -> CheckpointEngine:
-    return AsyncCheckpointEngine() if async_save else CheckpointEngine()

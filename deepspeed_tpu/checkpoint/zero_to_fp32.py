@@ -27,7 +27,12 @@ def get_fp32_state_dict_from_checkpoint(ckpt_dir: str, tag: str | None = None
     """Reference ``get_fp32_state_dict_from_zero_checkpoint`` analog."""
     tag = tag or ckpt_engine.latest_tag(ckpt_dir)
     base = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
-    arrays = ser.load_arrays(os.path.join(base, "model.npz"))
+    from deepspeed_tpu.checkpoint import sharded
+
+    if sharded.is_sharded(base, "model"):
+        arrays = sharded.assemble_full(base, "model")
+    else:
+        arrays = ser.load_arrays(os.path.join(base, "model.npz"))
     return {
         key.replace("['", "").replace("']", ".").rstrip("."): arr.astype(np.float32)
         for key, arr in arrays.items()

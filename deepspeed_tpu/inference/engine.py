@@ -89,8 +89,14 @@ class InferenceEngine:
         from deepspeed_tpu.checkpoint import engine as ckpt
         from deepspeed_tpu.checkpoint import serialization as ser
 
+        from deepspeed_tpu.checkpoint import sharded
+
         tag = ckpt.latest_tag(ckpt_dir)
         model_dir = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
+        if sharded.is_sharded(model_dir, "model"):
+            # fragments re-placed straight under the inference plan/dtype
+            self.params = sharded.load_sharded(self.params, model_dir, "model")
+            return
         arrays = ser.load_arrays(os.path.join(model_dir, "model.npz"))
         host = ser.arrays_to_tree(
             jax.tree_util.tree_map(np.asarray, self.params), arrays

@@ -197,6 +197,12 @@ class RaggedInferenceEngine:
                 f"request length {total} exceeds engine max_seq_len "
                 f"{self.cfg.max_seq_len}"
             )
+        worst = -(-total // self.cfg.block_size)
+        if worst > self.cfg.num_blocks - 1:
+            raise ValueError(
+                f"request needs {worst} KV blocks but the pool has only "
+                f"{self.cfg.num_blocks - 1} usable — it could never be admitted"
+            )
         self._queued.append(_SeqState(
             uid=uid, prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id if eos_token_id is not None else self.eos_token_id,

@@ -511,14 +511,20 @@ class Engine:
     def save_checkpoint(self, save_dir: str, tag: str | None = None,
                         client_state: dict | None = None, save_latest: bool = True):
         """Reference ``engine.py:4557 save_checkpoint``: tagged dir + manifest +
-        full-array (universal-layout) model/optimizer files + ``latest``."""
+        per-process sharded model/optimizer fragment files + ``latest``.
+
+        Every process writes only its own unique (replica-0) shards — the
+        reference's per-rank ``zero_pp_rank_*`` files, in universal-fragment
+        form (``ds_to_universal.py``) so any mesh can load them. With
+        ``checkpoint.async_save`` the host snapshot happens here (the double
+        buffer) and the disk flush runs on a writer thread."""
         import os
+        import threading
 
         from deepspeed_tpu.checkpoint import engine as ckpt
+        from deepspeed_tpu.checkpoint import sharded
         from deepspeed_tpu.checkpoint import serialization as ser
 
-        if getattr(self, "_ckpt_engine", None) is None:
-            self._ckpt_engine = ckpt.get_checkpoint_engine(self.config.checkpoint.async_save)
         tag = tag or f"global_step{self.global_steps}"
         ckpt_dir = os.path.join(save_dir, str(tag))
         manifest = {
@@ -531,32 +537,64 @@ class Engine:
             "micro_steps": self.micro_steps,
             "skipped_steps": self.skipped_steps,
             "loss_scale": float(self.scale_state.scale),
+            "scale_state": {k: float(v) for k, v in self.scale_state._asdict().items()},
             "lr_scheduler": self.lr_scheduler.state_dict(),
             "world_size": self.topo.world_size,
             "mesh": dict(self.topo.sizes),
             "config": self.config.to_dict(),
             "client_state": client_state or {},
         }
-        state = {
-            "manifest": manifest,
-            "model": ser.tree_to_arrays(self.params),
-            "optimizer": {
-                **ser.tree_to_arrays(self.opt_state),
-                **{f"__scale__{k}": np.asarray(v)
-                   for k, v in self.scale_state._asdict().items()},
-            },
-        }
+        # snapshot to host now (double buffer); flush sync or on writer thread
+        model_payload = sharded.collect_fragments(self.params, "model")
+        opt_payload = sharded.collect_fragments(self.opt_state, "optimizer")
+
+        def flush():
+            import jax as _jax
+
+            sharded.write_fragments(ckpt_dir, "model", *model_payload)
+            sharded.write_fragments(ckpt_dir, "optimizer", *opt_payload)
+            if _jax.process_index() == 0:
+                ser.save_json(os.path.join(ckpt_dir, "manifest.json"), manifest)
+            dist.barrier("save_checkpoint")
+            if _jax.process_index() == 0:
+                sharded.finalize_index(ckpt_dir, "model")
+                sharded.finalize_index(ckpt_dir, "optimizer")
+                if save_latest:
+                    ckpt.write_latest(save_dir, str(tag))
+                ckpt.rotate_checkpoints(save_dir, self.config.checkpoint.keep_n_latest)
+            log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+
+        self._join_ckpt_writer()
         import jax as _jax
 
-        if _jax.process_index() == 0:
-            self._ckpt_engine.save(state, ckpt_dir)
-            self._ckpt_engine.wait() if not self.config.checkpoint.async_save else None
-            if save_latest:
-                ckpt.write_latest(save_dir, str(tag))
-            ckpt.rotate_checkpoints(save_dir, self.config.checkpoint.keep_n_latest)
-        dist.barrier("save_checkpoint")
-        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+        # async flush only off the main thread when the barrier is a no-op
+        # (single process): a collective barrier on a writer thread could
+        # interleave with training collectives on multi-host
+        if self.config.checkpoint.async_save and _jax.process_count() == 1:
+            def flush_capturing():
+                try:
+                    flush()
+                except BaseException as e:  # surfaced on the next join
+                    self._ckpt_writer_error = e
+
+            # non-daemon: interpreter exit waits for the flush, so the last
+            # checkpoint of a run cannot be silently lost
+            self._ckpt_writer = threading.Thread(target=flush_capturing)
+            self._ckpt_writer.start()
+        else:
+            flush()
         return ckpt_dir
+
+    def _join_ckpt_writer(self):
+        """Wait for an in-flight async checkpoint flush; raises its error."""
+        w = getattr(self, "_ckpt_writer", None)
+        if w is not None:
+            w.join()
+            self._ckpt_writer = None
+        err = getattr(self, "_ckpt_writer_error", None)
+        if err is not None:
+            self._ckpt_writer_error = None
+            raise RuntimeError("async checkpoint flush failed") from err
 
     def load_checkpoint(self, load_dir: str, tag: str | None = None,
                         load_optimizer_states: bool = True,
@@ -569,31 +607,52 @@ class Engine:
         from deepspeed_tpu.checkpoint import engine as ckpt
         from deepspeed_tpu.checkpoint import serialization as ser
 
+        from deepspeed_tpu.checkpoint import sharded
+
+        self._join_ckpt_writer()
         tag = tag or ckpt.latest_tag(load_dir)
         if tag is None:
             log_dist(f"no checkpoint found under {load_dir}", ranks=[0])
             return None, {}
         ckpt_dir = os.path.join(load_dir, str(tag))
-        engine_io = ckpt.CheckpointEngine()
-        names = ["model"] + (["optimizer"] if load_optimizer_states else [])
-        state = engine_io.load(ckpt_dir, names)
-        manifest = state["manifest"]
+        manifest = ser.load_json(os.path.join(ckpt_dir, "manifest.json"))
 
-        params_host = ser.arrays_to_tree(
-            jax.tree_util.tree_map(np.asarray, self.params), state["model"]
-        )
-        self.params = jax.device_put(params_host, self.plan.param_shardings)
-        if load_optimizer_states and "optimizer" in state:
-            opt_arrays = {k: v for k, v in state["optimizer"].items()
-                          if not k.startswith("__scale__")}
-            opt_host = ser.arrays_to_tree(
-                jax.tree_util.tree_map(np.asarray, self.opt_state), opt_arrays
+        if sharded.is_sharded(ckpt_dir, "model"):
+            # assemble only this process's target shards from the fragments
+            self.params = sharded.load_sharded(self.params, ckpt_dir, "model")
+            if load_optimizer_states and sharded.is_sharded(ckpt_dir, "optimizer"):
+                self.opt_state = sharded.load_sharded(
+                    self.opt_state, ckpt_dir, "optimizer")
+                scale_kw = manifest.get("scale_state")
+                if scale_kw:
+                    self.scale_state = LossScaleState(
+                        scale=jnp.float32(scale_kw["scale"]),
+                        good_steps=jnp.int32(scale_kw["good_steps"]),
+                        hysteresis=jnp.int32(scale_kw["hysteresis"]),
+                        dynamic=jnp.asarray(bool(scale_kw["dynamic"])),
+                    )
+        else:
+            # legacy single-file universal layout
+            engine_io = ckpt.CheckpointEngine()
+            names = ["model"] + (["optimizer"] if load_optimizer_states else [])
+            state = engine_io.load(ckpt_dir, names)
+
+            params_host = ser.arrays_to_tree(
+                jax.tree_util.tree_map(np.asarray, self.params), state["model"]
             )
-            self.opt_state = jax.device_put(opt_host, self._opt_shardings)
-            scale_kw = {k[len("__scale__"):]: jnp.asarray(v)
-                        for k, v in state["optimizer"].items() if k.startswith("__scale__")}
-            if scale_kw:
-                self.scale_state = LossScaleState(**scale_kw)
+            self.params = jax.device_put(params_host, self.plan.param_shardings)
+            if load_optimizer_states and "optimizer" in state:
+                opt_arrays = {k: v for k, v in state["optimizer"].items()
+                              if not k.startswith("__scale__")}
+                opt_host = ser.arrays_to_tree(
+                    jax.tree_util.tree_map(np.asarray, self.opt_state), opt_arrays
+                )
+                self.opt_state = jax.device_put(opt_host, self._opt_shardings)
+                scale_kw = {k[len("__scale__"):]: jnp.asarray(v)
+                            for k, v in state["optimizer"].items()
+                            if k.startswith("__scale__")}
+                if scale_kw:
+                    self.scale_state = LossScaleState(**scale_kw)
         self.global_steps = int(manifest["global_steps"])
         self.global_samples = int(manifest["global_samples"])
         self.micro_steps = int(manifest["micro_steps"])

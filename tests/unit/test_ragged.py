@@ -134,9 +134,9 @@ class TestRaggedEngine:
         out = eng.generate_all()["x"]
         assert out == [first]  # stopped at eos, not max_new
 
-    def test_pool_deadlock_detected(self):
-        """An undersized KV pool with all sequences stalled must raise, not
-        livelock with silent empty steps."""
+    def test_never_admittable_request_rejected_at_put(self):
+        """A request whose worst case exceeds the whole pool is rejected
+        upfront instead of stalling the queue and deadlocking the engine."""
         tiny_pool = RaggedConfig(
             max_tokens_per_step=8, max_seqs=2, block_size=2,
             num_blocks=3, max_blocks_per_seq=8,
@@ -146,10 +146,11 @@ class TestRaggedEngine:
             dtype=jnp.float32, seed=0,
         )
         r = np.random.default_rng(0)
-        eng.put("a", r.integers(0, CFG.vocab_size, 6), max_new_tokens=4)
-        eng.put("b", r.integers(0, CFG.vocab_size, 6), max_new_tokens=4)
-        with pytest.raises(RuntimeError, match="deadlock"):
-            eng.generate_all()
+        with pytest.raises(ValueError, match="never be admitted"):
+            eng.put("a", r.integers(0, CFG.vocab_size, 6), max_new_tokens=4)
+        # a request that does fit the pool still completes
+        eng.put("ok", r.integers(0, CFG.vocab_size, 2), max_new_tokens=2)
+        assert len(eng.generate_all()["ok"]) == 2
 
     def test_conservative_admission_completes_oversubscribed_load(self):
         """Requests whose combined worst case exceeds the pool but which fit
