@@ -1,0 +1,105 @@
+"""Quantized collectives: int8 payloads on the wire, error feedback kept.
+
+Role parity with the reference's communication reducers:
+- ZeRO++ qgZ ``all_to_all_quant_reduce`` and LOCO variant
+  (``runtime/comm/coalesced_collectives.py:31,81``): quantize -> all-to-all of
+  the int8 chunks -> local dequant+reduce -> requantize -> all-gather -> dequant,
+  with the second-stage (owner-segment) error fed back LOCO-style.
+- 1-bit / compressed allreduce backends (``runtime/comm/nccl.py:17``,
+  ``compressed.py:14``): rank-local error feedback so quantization bias
+  vanishes over steps.
+
+TPU-native expression: the whole reducer runs inside ``shard_map`` and the
+``lax.all_to_all`` / ``all_gather`` operands ARE the int8 payload plus the
+small fp32 per-block scale vectors — wire bytes drop ~4x vs an fp32 ring
+allreduce (the HLO-level test asserts the collective operand dtype is s8).
+Intended for the bandwidth-poor axis (DCN between slices — the TPU analog of
+the reference's inter-node links).
+"""
+
+from __future__ import annotations
+
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.quantizer import dequantize, quantize
+
+
+def _pad_to(flat: jnp.ndarray, multiple: int) -> jnp.ndarray:
+    pad = (-flat.size) % multiple
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+def quantized_all_reduce(x, axis_name: str, error=None, bits: int = 8,
+                         block: int = 64):
+    """Mean-allreduce of rank-local ``x`` over ``axis_name`` with int8 wire
+    payloads (call inside ``shard_map``).
+
+    Returns ``(mean, new_error)``. ``error`` is this rank's residual from the
+    previous call (same shape as ``x``); the first-stage quantization error
+    stays local, and the owner-segment second-stage error is re-injected
+    scaled by the axis size (LOCO) so the *mean* converges.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    shape = x.shape
+    xf = x.astype(jnp.float32)
+    if error is not None:
+        xf = xf + error.astype(jnp.float32)
+
+    flat = _pad_to(xf.reshape(-1), n * block)
+    chunk = flat.size // n
+    chunks = flat.reshape(n, chunk)
+
+    # stage 1: quantize per chunk; all-to-all the int8 payload + scales
+    qt = quantize(chunks, bits=bits, block=block)
+    e1 = flat - dequantize(qt).reshape(-1)
+    v = qt.values.reshape(n, -1)                      # int8 [n, chunk_bytes]
+    s = qt.scales.reshape(n, -1)                      # f32  [n, chunk//block]
+    v_recv = lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0)
+    s_recv = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+
+    # local dequant + reduce of my segment
+    blocks = v_recv.reshape(-1, block).astype(jnp.float32)
+    scales = s_recv.reshape(-1)
+    seg = (blocks * scales[:, None]).reshape(n, chunk).sum(axis=0) / n
+
+    # stage 2: requantize my reduced segment; all-gather int8
+    qt2 = quantize(seg, bits=bits, block=block)
+    e2 = seg - dequantize(qt2).reshape(-1)[:chunk]
+    v2 = lax.all_gather(qt2.values.reshape(-1), axis_name)   # int8 [n, ...]
+    s2 = lax.all_gather(qt2.scales, axis_name)
+    out_blocks = v2.reshape(-1, block).astype(jnp.float32)
+    out = (out_blocks * s2.reshape(-1)[:, None]).reshape(-1)[: flat.size]
+    mean = out[: xf.size].reshape(shape)
+
+    # error feedback: my own stage-1 residuals (for every destination chunk)
+    # plus my owner-segment stage-2 residual scaled back to sum space
+    seg_err = lax.dynamic_update_slice(
+        jnp.zeros_like(flat), e2 * n, (my * chunk,))
+    new_error = (e1 + seg_err)[: xf.size].reshape(shape)
+    return mean.astype(x.dtype), new_error.astype(jnp.float32)
+
+
+def quantized_all_reduce_arrays(x, error, mesh, axis_name: str,
+                                bits: int = 8, block: int = 64):
+    """Array-level wrapper for rank-varying inputs outside ``shard_map``:
+    ``x``/``error`` carry a leading axis of size ``n`` sharded over
+    ``axis_name`` (each rank's local contribution / residual)."""
+    spec_x = P(axis_name)
+
+    def body(xs, es):
+        mean, new_e = quantized_all_reduce(
+            xs[0], axis_name, es[0], bits=bits, block=block)
+        return mean[None], new_e[None]
+
+    out_mean_spec = P(None)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec_x, spec_x),
+        out_specs=(out_mean_spec, spec_x),
+        axis_names={axis_name}, check_vma=False,
+    )(x, error)

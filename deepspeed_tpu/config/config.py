@@ -26,7 +26,7 @@ class OptimizerConfig(ConfigBase):
     type: str = "adamw"  # adamw | adam | sgd | lion | lamb | adagrad
     params: dict = field(default_factory=dict)
 
-    _SUPPORTED: ClassVar[set] = {"adam", "adamw", "sgd", "lion", "lamb", "adagrad", "muon"}
+    _SUPPORTED: ClassVar[set] = {"adam", "adamw", "sgd", "lion", "lamb", "adagrad", "muon", "onebit_adam", "onebitadam", "1bit-adam"}
 
     def _validate(self, path: str = "") -> None:
         if self.type.lower() not in self._SUPPORTED:
@@ -75,12 +75,23 @@ class OffloadConfig(ConfigBase):
     nvme_path: str = "/tmp/dstpu_nvme"
     pin_memory: bool = True
     buffer_count: int = 4
-    # ZenFlow-style split: top-k important gradient columns stay on device.
-    zenflow_topk_ratio: float = 0.0
 
     def _validate(self, path: str = "") -> None:
         if self.device not in ("none", "cpu", "nvme"):
             raise ConfigError(f"{path}device: must be none|cpu|nvme, got {self.device!r}")
+
+    @classmethod
+    def from_dict(cls, data, path: str = ""):
+        data = dict(data or {})
+        if "zenflow_topk_ratio" in data:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                f"Config field '{path}zenflow_topk_ratio' is not supported in "
+                "this build and is ignored."
+            )
+            data.pop("zenflow_topk_ratio")
+        return super().from_dict(data, path=path)
 
 
 @dataclass
@@ -99,11 +110,12 @@ class ZeroConfig(ConfigBase):
     offload_param: OffloadConfig = field(default_factory=OffloadConfig)
     # stage-3 style knobs
     persistence_threshold: int = 0  # params smaller than this stay replicated
-    # ZeRO++ style int8-quantized collectives
-    quantized_weights: bool = False
+    # offload windowing: elements per optimizer sub-group (reference stage3
+    # sub_group_size); one group's state is in HBM at a time
+    sub_group_size: int = 100_000_000
+    # ZeRO++ qgZ: int8-quantized gradient reduction with error feedback
+    # (comm/quantized_collectives.py; requires a pure data-parallel mesh)
     quantized_gradients: bool = False
-    # MiCS/hpZ: secondary replication group size (0 = off)
-    zero_hpz_partition_size: int = 0
 
     def _validate(self, path: str = "") -> None:
         if self.stage not in (0, 1, 2, 3):
@@ -112,6 +124,17 @@ class ZeroConfig(ConfigBase):
     @classmethod
     def from_dict(cls, data, path: str = ""):
         data = dict(data or {})
+        # Reference knobs this build doesn't implement: accept + warn rather
+        # than hard-failing ported DeepSpeed configs.
+        for unsupported in ("quantized_weights", "zero_hpz_partition_size"):
+            if unsupported in data:
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning(
+                    f"Config field '{path}{unsupported}' is not supported in "
+                    "this build and is ignored."
+                )
+                data.pop(unsupported)
         # Legacy `cpu_offload` was a bool; translate to an offload tier, not a rename.
         if "cpu_offload" in data:
             from deepspeed_tpu.utils.logging import logger

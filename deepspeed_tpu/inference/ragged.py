@@ -198,10 +198,11 @@ class RaggedInferenceEngine:
                 f"{self.cfg.max_seq_len}"
             )
         worst = -(-total // self.cfg.block_size)
-        if worst > self.cfg.num_blocks - 1:
+        if worst > min(self.cfg.num_blocks - 1, self.cfg.max_blocks_per_seq):
             raise ValueError(
-                f"request needs {worst} KV blocks but the pool has only "
-                f"{self.cfg.num_blocks - 1} usable — it could never be admitted"
+                f"request needs {worst} KV blocks but at most "
+                f"{min(self.cfg.num_blocks - 1, self.cfg.max_blocks_per_seq)} "
+                "are available per sequence — it could never be admitted"
             )
         self._queued.append(_SeqState(
             uid=uid, prompt=prompt, max_new_tokens=max_new_tokens,

@@ -69,7 +69,57 @@ def build_optimizer(
         if muon is None:
             raise NotImplementedError("optax.contrib.muon unavailable in this optax build")
         return muon(lr)
+    if t in ("onebit_adam", "onebitadam", "1bit-adam"):
+        tx = scale_by_onebit_adam(
+            warmup_steps=int(p.get("freeze_step", p.get("warmup_steps", 100))),
+            **_adam_args(p),
+        )
+        parts = [tx]
+        if wd:
+            parts.append(optax.add_decayed_weights(wd))
+        parts.append(optax.scale_by_learning_rate(lr))
+        return optax.chain(*parts)
     raise ValueError(f"unsupported optimizer type {cfg.type!r}")
+
+
+def scale_by_onebit_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                         warmup_steps: int = 100) -> optax.GradientTransformation:
+    """1-bit-Adam semantics (reference ``runtime/fp16/onebit/adam.py``):
+    standard Adam during the warmup phase, then the variance ``nu`` FREEZES
+    and only the momentum keeps updating — the property that makes compressed
+    gradient/momentum communication safe after warmup. Pair with
+    ``zero_optimization.quantized_gradients`` for the compressed wire
+    (``comm/quantized_collectives.py``); this transform supplies the matching
+    optimizer math.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        nu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update(updates, state, params=None):
+        del params
+        count = state.count + 1
+        in_warmup = count <= warmup_steps
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            state.mu, updates)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(
+                in_warmup, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
+            state.nu, updates)
+        # bias correction: nu's correction uses the step it froze at
+        nu_count = jnp.minimum(count, warmup_steps)
+        mc = 1 - b1 ** count.astype(jnp.float32)
+        vc = 1 - b2 ** nu_count.astype(jnp.float32)
+        out = jax.tree_util.tree_map(
+            lambda m, v: (m / mc) / (jnp.sqrt(v / vc) + eps), mu, nu)
+        return out, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
 
 
 def base_lr(cfg: OptimizerConfig) -> float:

@@ -238,6 +238,32 @@ def opt_state_shardings(optimizer, abstract_params, plan: ShardingPlan):
     return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
+def grouped_opt_state_shardings(optimizer, group_leaves: tuple, group_shardings,
+                                mesh):
+    """Shardings for an optimizer state over a TUPLE of param leaves (the
+    offload sub-group representation): state leaves congruent to the i-th
+    group leaf (matched by trailing tuple index + shape) inherit its sharding,
+    scalars replicate."""
+    abstract = tuple(
+        jax.ShapeDtypeStruct(tuple(x.shape), x.dtype) for x in group_leaves
+    )
+    abstract_state = jax.eval_shape(optimizer.init, abstract)
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def spec(path, leaf):
+        last = path[-1] if path else None
+        i = getattr(last, "idx", None)
+        if (i is not None and i < len(group_leaves)
+                and tuple(leaf.shape) == tuple(group_leaves[i].shape)):
+            return group_shardings[i]
+        return replicated
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec(p, l) for p, l in flat]
+    )
+
+
 def _lookup_spec(spec_tree, path):
     node = spec_tree
     for k in path:

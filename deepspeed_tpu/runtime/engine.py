@@ -142,25 +142,76 @@ class Engine:
         self.optimizer = build_optimizer(config.optimizer, learning_rate=1.0)
         self._opt_shardings = opt_state_shardings(self.optimizer, self.params, self.plan)
 
-        # ZeRO-Offload: pin optimizer state in host DRAM (reference: zero
-        # cpu-offload + cpu_adam; here the state streams to HBM inside the step)
+        # ZeRO-Offload / ZeRO-Infinity tiers (reference: zero cpu-offload +
+        # cpu_adam + runtime/swap_tensor). Offloaded optimizer state is
+        # WINDOWED into sub-groups (reference stage3.py:2360 _prepare_sub_group)
+        # so only ~one group is HBM-resident during the update:
+        #   cpu : per-group states pinned in host DRAM, streamed through HBM
+        #         group-by-group inside the jitted step
+        #   nvme: per-group states on disk via the AIO engine, prefetch of
+        #         group k+1 overlapping the update of group k
         from deepspeed_tpu.runtime import offload as offload_mod
 
-        self._offload_opt = False
-        if zero.offload_optimizer.device in ("cpu", "nvme"):
-            if offload_mod.supports_memory_kinds():
-                self._offload_opt = True
-                self._opt_shardings_device = self._opt_shardings
-                self._opt_shardings = offload_mod.offload_shardings(self._opt_shardings)
-                log_dist("optimizer state offloaded to pinned host memory", ranks=[0])
-            else:
-                log_dist(
-                    "offload_optimizer requested but this backend has no host "
-                    "memory tier; keeping state on device", ranks=[0],
+        self._offload_mode: str | None = None
+        self._groups: list[list[int]] | None = None
+        self._swapper = None
+        param_leaves, self._param_treedef = jax.tree_util.tree_flatten(self.params)
+        dev = zero.offload_optimizer.device
+        if dev in ("cpu", "nvme"):
+            self._offload_mode = dev
+            self._groups = offload_mod.partition_groups(
+                [int(x.size) for x in param_leaves], zero.sub_group_size
+            )
+        if self._offload_mode == "cpu":
+            from deepspeed_tpu.parallel.partition import grouped_opt_state_shardings
+
+            host_ok = offload_mod.supports_memory_kinds(topo.mesh)
+            shard_leaves = jax.tree_util.tree_leaves(self.plan.param_shardings)
+            self._group_shardings = []  # (device_kind, storage_kind) per group
+            self.opt_state = []
+            for idx in self._groups:
+                g_leaves = tuple(param_leaves[i] for i in idx)
+                g_shards = [shard_leaves[i] for i in idx]
+                dev_sh = grouped_opt_state_shardings(
+                    self.optimizer, g_leaves, g_shards, topo.mesh)
+                store_sh = offload_mod.offload_shardings(dev_sh) if host_ok else dev_sh
+                self._group_shardings.append((dev_sh, store_sh))
+                self.opt_state.append(
+                    jax.jit(self.optimizer.init, out_shardings=store_sh)(g_leaves)
                 )
-        self.opt_state = jax.jit(
-            self.optimizer.init, out_shardings=self._opt_shardings
-        )(self.params)
+            log_dist(
+                f"optimizer state in {len(self._groups)} sub-groups "
+                + ("pinned in host DRAM" if host_ok else
+                   "(no host tier on this backend; windowing only)"),
+                ranks=[0],
+            )
+        elif self._offload_mode == "nvme":
+            from deepspeed_tpu.runtime.nvme_swap import AsyncTensorSwapper
+
+            self._swapper = AsyncTensorSwapper(zero.offload_optimizer.nvme_path)
+            self._nvme_templates = []
+            for g, idx in enumerate(self._groups):
+                g_abs = tuple(
+                    jax.ShapeDtypeStruct(tuple(param_leaves[i].shape), jnp.float32)
+                    for i in idx
+                )
+                abstract = jax.eval_shape(self.optimizer.init, g_abs)
+                zeros = jax.tree_util.tree_map(
+                    lambda l: np.zeros(l.shape, l.dtype), abstract)
+                self._nvme_templates.append(abstract)
+                # windowed init: one group's zeros in host RAM at a time
+                self._swapper.wait_keys(
+                    self._swapper.swap_out_tree(f"opt_g{g}", zeros))
+            self._swapper.commit()
+            self.opt_state = None  # never resident: lives on NVMe between steps
+            log_dist(
+                f"optimizer state on NVMe ({zero.offload_optimizer.nvme_path}) "
+                f"in {len(self._groups)} sub-groups", ranks=[0],
+            )
+        else:
+            self.opt_state = jax.jit(
+                self.optimizer.init, out_shardings=self._opt_shardings
+            )(self.params)
 
         self.scale_state: LossScaleState = precision.init_loss_scale(config.fp16)
         self.lr_scheduler = LRScheduler(self.lr_schedule)
@@ -203,10 +254,44 @@ class Engine:
 
         self.monitor = MonitorMaster(config.monitor)
 
+        # ZeRO++-style quantized gradient reduction (qgZ): grads stay rank-
+        # local through the GAS scan inside a shard_map over the data axis and
+        # reduce ONCE at the boundary through int8 all-to-all/all-gather with
+        # error feedback (comm/quantized_collectives.py)
+        self._qgrad = bool(zero.quantized_gradients)
+        self._qgrad_error = None
+        if self._qgrad:
+            others = [a for a in ("fsdp", "tensor", "sequence", "pipeline", "expert")
+                      if topo.size(a) > 1]
+            if topo.size("data") <= 1 or others:
+                raise ValueError(
+                    "zero_optimization.quantized_gradients requires a pure "
+                    f"data-parallel mesh (data>1); got data={topo.size('data')}"
+                    + (f", active axes {others}" if others else "")
+                )
+            if self._offload_mode == "nvme":
+                raise ValueError(
+                    "quantized_gradients is not supported with NVMe-offloaded "
+                    "optimizer state")
+            n = topo.size("data")
+            err_sh = NamedSharding(topo.mesh, PartitionSpec("data"))
+            self._qgrad_error = jax.jit(
+                lambda: jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((n,) + tuple(p.shape), jnp.float32),
+                    self.params,
+                ),
+                out_shardings=jax.tree_util.tree_map(
+                    lambda _: err_sh, self.params),
+            )()
+            log_dist("gradient reduction: int8 quantized (qgZ) over the data "
+                     f"axis (n={n}) with error feedback", ranks=[0])
+
         self._train_batch_jit = None
         self._accum_jit = None
         self._apply_jit = None
         self._eval_jit = None
+        self._grads_jit = None
+        self._group_apply_jit = None
         log_dist(
             f"Engine: model={self.model_spec.name} params={self.model_spec.num_params:,} "
             f"zero_stage={self.zero_stage} precision={config.precision_name} "
@@ -225,6 +310,11 @@ class Engine:
         return self.plan.grad_shardings
 
     def _constrain_grads(self, grads):
+        if getattr(self, "_inside_manual_region", False):
+            # shard_map body (quantized reduction): GSPMD constraints over the
+            # manual axis are meaningless/invalid there
+            return jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
         ns = self._grad_ns()
         return jax.tree_util.tree_map(
             lambda g, s: jax.lax.with_sharding_constraint(g.astype(jnp.float32), s),
@@ -245,7 +335,13 @@ class Engine:
 
     def _update(self, params, opt_state, scale_state, grad_sum, n_micro, step):
         """Shared optimizer-step tail (reference ``_take_model_step:3168``):
-        unscale, overflow check, clip, update, loss-scale bookkeeping."""
+        unscale, overflow check, clip, update, loss-scale bookkeeping.
+
+        With the host offload tier, the update walks the optimizer sub-groups
+        sequentially inside the same XLA program — each group's state streams
+        host->HBM, updates, streams back, so peak HBM holds one group's state
+        while XLA's scheduler overlaps the next group's transfer with the
+        current group's compute."""
         cfg = self.config
         denom = scale_state.scale * n_micro
         grads = jax.tree_util.tree_map(lambda g: g / denom, grad_sum)
@@ -255,19 +351,35 @@ class Engine:
             coef = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
             grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
         lr = self.lr_schedule(step)
-        if self._offload_opt:
+
+        if self._offload_mode == "cpu":
             from deepspeed_tpu.runtime import offload as offload_mod
 
-            opt_state = offload_mod.stream_in(opt_state, self._opt_shardings_device)
-        updates, new_opt = self.optimizer.update(grads, opt_state, params)
-        updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
-        new_params = optax.apply_updates(params, updates)
-        new_params = _tree_select(finite, new_params, params)
-        new_opt = _tree_select(finite, new_opt, opt_state)
-        if self._offload_opt:
-            from deepspeed_tpu.runtime import offload as offload_mod
-
-            new_opt = offload_mod.stream_out(new_opt, self._opt_shardings)
+            p_leaves = jax.tree_util.tree_leaves(params)
+            g_leaves = jax.tree_util.tree_leaves(grads)
+            new_p_leaves = list(p_leaves)
+            new_opt = []
+            for g, idx in enumerate(self._groups):
+                pg = tuple(p_leaves[i] for i in idx)
+                gg = tuple(g_leaves[i] for i in idx)
+                dev_sh, store_sh = self._group_shardings[g]
+                state = offload_mod.stream_in(opt_state[g], dev_sh)
+                updates, new_state = self.optimizer.update(gg, state, pg)
+                newp = optax.apply_updates(
+                    pg, jax.tree_util.tree_map(lambda u: u * lr, updates))
+                newp = _tree_select(finite, newp, pg)
+                new_state = _tree_select(finite, new_state, state)
+                new_opt.append(offload_mod.stream_out(new_state, store_sh))
+                for j, i in enumerate(idx):
+                    new_p_leaves[i] = newp[j]
+            new_params = jax.tree_util.tree_unflatten(
+                self._param_treedef, new_p_leaves)
+        else:
+            updates, new_opt = self.optimizer.update(grads, opt_state, params)
+            updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
+            new_params = optax.apply_updates(params, updates)
+            new_params = _tree_select(finite, new_params, params)
+            new_opt = _tree_select(finite, new_opt, opt_state)
         new_scale = precision.update_loss_scale(scale_state, finite, cfg.fp16)
         metrics = {
             "grad_norm": gnorm,
@@ -277,19 +389,24 @@ class Engine:
         }
         return new_params, new_opt, new_scale, metrics
 
-    def _build_train_batch_fn(self):
+    def _gas_grads(self, params, scale_state, step, base_rng, batch):
+        """The traced GAS fwd/bwd body shared by the fused step and the
+        split (offload) step: per-step rng fold-in, microbatch scan, fp32
+        grad accumulation under the ZeRO sharding. Returns (mean loss, acc)."""
         gas = self.gas
+        scale = scale_state.scale
+        # derive the step's rng on-device: no host random.split round trip
+        rng = jax.random.fold_in(base_rng, step)
 
-        def train_batch_fn(params, opt_state, scale_state, step, base_rng, batch):
-            scale = scale_state.scale
-            # derive the step's rng on-device: no host random.split round trip
-            rng = jax.random.fold_in(base_rng, step)
-
-            if gas == 1:
-                # fast path: no accumulation buffer, no scan machinery
-                mb = jax.tree_util.tree_map(lambda x: x[0], batch)
-                loss, acc = self._microbatch_grads(params, mb, rng, scale)
-                losses = loss[None]
+        if gas == 1:
+            # fast path: no accumulation buffer, no scan machinery
+            mb = jax.tree_util.tree_map(lambda x: x[0], batch)
+            loss, acc = self._microbatch_grads(params, mb, rng, scale)
+            losses = loss[None]
+        else:
+            if getattr(self, "_inside_manual_region", False):
+                acc0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
             else:
                 acc0 = jax.tree_util.tree_map(
                     lambda p, s: jax.lax.with_sharding_constraint(
@@ -299,21 +416,171 @@ class Engine:
                     self._grad_ns(),
                 )
 
-                def micro(acc, idx_mb):
-                    idx, mb = idx_mb
-                    r = jax.random.fold_in(rng, idx)
-                    loss, grads = self._microbatch_grads(params, mb, r, scale)
-                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                    return acc, loss
+            def micro(acc, idx_mb):
+                idx, mb = idx_mb
+                r = jax.random.fold_in(rng, idx)
+                loss, grads = self._microbatch_grads(params, mb, r, scale)
+                return jax.tree_util.tree_map(jnp.add, acc, grads), loss
 
-                acc, losses = jax.lax.scan(micro, acc0, (jnp.arange(gas), batch))
+            acc, losses = jax.lax.scan(micro, acc0, (jnp.arange(gas), batch))
+        return jnp.mean(losses), acc
+
+    def _build_train_batch_fn(self):
+        if self._qgrad:
+            return self._build_train_batch_fn_qgrad()
+
+        def train_batch_fn(params, opt_state, scale_state, step, base_rng, batch):
+            loss, acc = self._gas_grads(params, scale_state, step, base_rng, batch)
             new_params, new_opt, new_scale, metrics = self._update(
-                params, opt_state, scale_state, acc, float(gas), step
+                params, opt_state, scale_state, acc, float(self.gas), step
             )
-            metrics["loss"] = jnp.mean(losses)
+            metrics["loss"] = loss
             return new_params, new_opt, new_scale, metrics
 
         return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2))
+
+    def _build_train_batch_fn_qgrad(self):
+        """Fused step with qgZ gradient reduction: the GAS fwd/bwd runs PER
+        DATA RANK inside shard_map (no implicit psum), then each grad leaf
+        reduces once through the int8 quantized collective with error
+        feedback; the optimizer tail runs on the replicated result."""
+        from deepspeed_tpu.comm.quantized_collectives import quantized_all_reduce
+        from deepspeed_tpu.comm.topology import AXIS_DATA
+
+        mesh = self.topo.mesh
+
+        def train_batch_fn(params, opt_state, scale_state, step, base_rng,
+                           batch, qerr):
+            def local(params, batch, qerr):
+                self._inside_manual_region = True
+                self.shard_ctx._suspend_constraints = True
+                try:
+                    loss, acc = self._gas_grads(
+                        params, scale_state, step, base_rng, batch)
+                finally:
+                    self._inside_manual_region = False
+                    self.shard_ctx._suspend_constraints = False
+                g_leaves, tdef = jax.tree_util.tree_flatten(acc)
+                e_leaves = jax.tree_util.tree_leaves(qerr)
+                red, nerr = [], []
+                for g, e in zip(g_leaves, e_leaves):
+                    r, ne = quantized_all_reduce(g, AXIS_DATA, e[0])
+                    red.append(r)
+                    nerr.append(ne[None])
+                return (jax.lax.pmean(loss, AXIS_DATA),
+                        jax.tree_util.tree_unflatten(tdef, red),
+                        jax.tree_util.tree_unflatten(tdef, nerr))
+
+            loss, acc, new_qerr = jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(PartitionSpec(), PartitionSpec(None, AXIS_DATA),
+                          PartitionSpec(AXIS_DATA)),
+                out_specs=(PartitionSpec(), PartitionSpec(),
+                           PartitionSpec(AXIS_DATA)),
+                axis_names={AXIS_DATA}, check_vma=False,
+            )(params, batch, qerr)
+            new_params, new_opt, new_scale, metrics = self._update(
+                params, opt_state, scale_state, acc, float(self.gas), step
+            )
+            metrics["loss"] = loss
+            # overflow step: keep the previous residuals — a NaN/Inf error
+            # buffer would poison every subsequent step's gradients
+            finite = jnp.logical_not(metrics["skipped"])
+            new_qerr = _tree_select(finite, new_qerr, qerr)
+            return new_params, new_opt, new_scale, metrics, new_qerr
+
+        return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2, 6))
+
+    def _build_grads_fn(self):
+        """Jitted fwd/bwd over the GAS scan WITHOUT the optimizer tail — the
+        ZeRO-Infinity step splits there so the update can walk NVMe-resident
+        sub-groups on the host."""
+        return jax.jit(self._gas_grads)
+
+    def _build_group_apply_fn(self):
+        """Sub-group optimizer apply: takes a group's param/grad leaf tuples +
+        its NVMe-loaded state, returns the updated leaves and state (jit
+        specializes per group's shapes automatically). ``factor`` folds
+        unscale+clip into one multiplier (coef / (scale * n_micro))."""
+
+        def apply_g(pg, state, gg, factor, lr):
+            gg = jax.tree_util.tree_map(lambda x: x * factor, gg)
+            updates, new_state = self.optimizer.update(gg, state, pg)
+            newp = optax.apply_updates(
+                pg, jax.tree_util.tree_map(lambda u: u * lr, updates))
+            return newp, new_state
+
+        return jax.jit(apply_g, donate_argnums=(1,))
+
+    def _train_batch_nvme(self, batch: dict):
+        """Full step with NVMe-resident optimizer state (reference
+        ZeRO-Infinity: ``pipelined_optimizer_swapper.py:52`` — prefetch window
+        k+1 while window k updates; writes are async with a commit barrier at
+        the step end)."""
+        if self._grads_jit is None:
+            self._grads_jit = self._build_grads_fn()
+        dev_batch = self._put_gas_batch(batch)
+        self.tput_timer.start()
+        # issue the group-0 NVMe read NOW: it overlaps the whole fwd/bwd
+        # (harmless if the step overflows — the read stays valid for the
+        # next step since skipped steps write nothing)
+        self._swapper.prefetch_tree("opt_g0", self._nvme_templates[0])
+        loss, grad_sum = self._grads_jit(
+            self.params, self.scale_state, jnp.int32(self.global_steps),
+            self._train_rng, dev_batch,
+        )
+        cfg = self.config
+        denom = self.scale_state.scale * jnp.float32(self.gas)
+        gnorm = _global_norm(grad_sum) / denom
+        finite = bool(precision.grads_finite(grad_sum))
+        coef = jnp.float32(1.0)
+        if cfg.gradient_clipping > 0:
+            coef = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
+        factor = coef / denom
+        lr = self.lr_schedule(jnp.int32(self.global_steps))
+
+        if finite:
+            p_leaves = jax.tree_util.tree_leaves(self.params)
+            g_leaves = jax.tree_util.tree_leaves(grad_sum)
+            new_p_leaves = list(p_leaves)
+            groups = self._groups
+            if self._group_apply_jit is None:
+                self._group_apply_jit = self._build_group_apply_fn()
+            prev_write_keys: list = []
+            for g, idx in enumerate(groups):
+                if g + 1 < len(groups):
+                    self._swapper.prefetch_tree(
+                        f"opt_g{g + 1}", self._nvme_templates[g + 1])
+                state = self._swapper.swap_in_tree(
+                    f"opt_g{g}", self._nvme_templates[g])
+                pg = tuple(p_leaves[i] for i in idx)
+                gg = tuple(g_leaves[i] for i in idx)
+                newp, new_state = self._group_apply_jit(
+                    pg, state, gg, factor, lr)
+                # windowed write pipeline: free group g-1's write buffers
+                # before snapshotting group g, so host RAM holds ~one group
+                self._swapper.wait_keys(prev_write_keys)
+                prev_write_keys = self._swapper.swap_out_tree(
+                    f"opt_g{g}",
+                    jax.tree_util.tree_map(np.asarray, new_state))
+                for j, i in enumerate(idx):
+                    new_p_leaves[i] = newp[j]
+            self.params = jax.tree_util.tree_unflatten(
+                self._param_treedef, new_p_leaves)
+            self._swapper.commit()
+        self.scale_state = precision.update_loss_scale(
+            self.scale_state, jnp.asarray(finite), cfg.fp16)
+        metrics = {
+            "loss": loss,
+            "grad_norm": gnorm,
+            "lr": lr,
+            "loss_scale": denom / self.gas,
+            "skipped": jnp.asarray(not finite),
+        }
+        self.tput_timer.stop(global_step=True)
+        self._after_step(metrics)
+        self.micro_steps += self.gas
+        return metrics["loss"]
 
     def _build_accum_fn(self):
         def accum_fn(params, acc, scale_state, rng, mb):
@@ -378,18 +645,28 @@ class Engine:
                 data_iter = self.training_dataloader
             micro = [next(data_iter) for _ in range(self.gas)]
             batch = {k: np.concatenate([np.asarray(m[k]) for m in micro]) for k in micro[0]}
+        if self._offload_mode == "nvme":
+            return self._train_batch_nvme(batch)
         if self._train_batch_jit is None:
             self._train_batch_jit = self._build_train_batch_fn()
         dev_batch = self._put_gas_batch(batch)
         self.tput_timer.start()
-        self.params, self.opt_state, self.scale_state, metrics = self._train_batch_jit(
-            self.params,
-            self.opt_state,
-            self.scale_state,
-            jnp.int32(self.global_steps),
-            self._train_rng,
-            dev_batch,
-        )
+        if self._qgrad:
+            (self.params, self.opt_state, self.scale_state, metrics,
+             self._qgrad_error) = self._train_batch_jit(
+                self.params, self.opt_state, self.scale_state,
+                jnp.int32(self.global_steps), self._train_rng, dev_batch,
+                self._qgrad_error,
+            )
+        else:
+            self.params, self.opt_state, self.scale_state, metrics = self._train_batch_jit(
+                self.params,
+                self.opt_state,
+                self.scale_state,
+                jnp.int32(self.global_steps),
+                self._train_rng,
+                dev_batch,
+            )
         # NO per-step device sync here: over a tunneled TPU each host<->device
         # round trip costs more than the update tail; steps pipeline and Python
         # overhead hides under device compute. _after_step syncs only when a
@@ -419,6 +696,12 @@ class Engine:
         Returns the (unscaled) loss. Gradients live in a persistent buffer
         sharded per the ZeRO plan until ``step()`` consumes them.
         """
+        if self._offload_mode == "nvme" or self._qgrad:
+            raise NotImplementedError(
+                "the fwd/bwd/step parity path does not support NVMe-offloaded "
+                "optimizer state or quantized gradient reduction; use "
+                "train_batch()"
+            )
         if self._accum_jit is None:
             self._accum_jit = self._build_accum_fn()
         if self._acc_grads is None:
@@ -546,7 +829,28 @@ class Engine:
         }
         # snapshot to host now (double buffer); flush sync or on writer thread
         model_payload = sharded.collect_fragments(self.params, "model")
-        opt_payload = sharded.collect_fragments(self.opt_state, "optimizer")
+        if self._offload_mode == "nvme":
+            # state lives on disk between steps; stream it GROUP BY GROUP into
+            # per-group fragment files so host RAM never holds the full
+            # optimizer state (a [None]*g placeholder list reproduces the
+            # grouped-save key layout; the index's per-fragment file names
+            # point the loader at the right group file)
+            import jax as _jax
+
+            os.makedirs(ckpt_dir, exist_ok=True)
+            index: dict = {}
+            for g, t in enumerate(self._nvme_templates):
+                state = self._swapper.swap_in_tree(f"opt_g{g}", t)
+                p, ix = sharded.collect_fragments(
+                    [None] * g + [state], f"optimizer_g{g}")
+                np.savez(os.path.join(
+                    ckpt_dir,
+                    f"optimizer_g{g}_shard_p{_jax.process_index()}.npz"), **p)
+                index.update(ix)
+                del state, p
+            opt_payload = ({}, index)
+        else:
+            opt_payload = sharded.collect_fragments(self.opt_state, "optimizer")
 
         def flush():
             import jax as _jax
@@ -621,8 +925,28 @@ class Engine:
             # assemble only this process's target shards from the fragments
             self.params = sharded.load_sharded(self.params, ckpt_dir, "model")
             if load_optimizer_states and sharded.is_sharded(ckpt_dir, "optimizer"):
-                self.opt_state = sharded.load_sharded(
-                    self.opt_state, ckpt_dir, "optimizer")
+                try:
+                    if self._offload_mode == "nvme":
+                        # stream back group by group: one group in host RAM
+                        for g, t in enumerate(self._nvme_templates):
+                            template = [None] * g + [jax.tree_util.tree_map(
+                                lambda l: np.zeros(tuple(l.shape), l.dtype), t)]
+                            state = sharded.load_sharded(
+                                template, ckpt_dir, "optimizer")[g]
+                            self._swapper.wait_keys(
+                                self._swapper.swap_out_tree(f"opt_g{g}", state))
+                        self._swapper.commit()
+                    else:
+                        self.opt_state = sharded.load_sharded(
+                            self.opt_state, ckpt_dir, "optimizer")
+                except KeyError as e:
+                    raise ValueError(
+                        "optimizer checkpoint layout does not match this "
+                        "engine's offload configuration (offloaded optimizer "
+                        "state is stored in sub-groups). Load with the same "
+                        "offload_optimizer/sub_group_size settings it was "
+                        "saved under, or pass load_optimizer_states=False"
+                    ) from e
                 scale_kw = manifest.get("scale_state")
                 if scale_kw:
                     self.scale_state = LossScaleState(
@@ -633,6 +957,12 @@ class Engine:
                     )
         else:
             # legacy single-file universal layout
+            if self._offload_mode is not None and load_optimizer_states:
+                raise ValueError(
+                    "legacy-format checkpoints cannot restore optimizer state "
+                    "into an offloaded (sub-grouped) engine; pass "
+                    "load_optimizer_states=False or load without offload"
+                )
             engine_io = ckpt.CheckpointEngine()
             names = ["model"] + (["optimizer"] if load_optimizer_states else [])
             state = engine_io.load(ckpt_dir, names)

@@ -82,6 +82,21 @@ class AsyncTensorSwapper:
         self._inflight.clear()
         self._buffers.clear()
 
+    def wait_keys(self, keys: list[str]) -> None:
+        """Await specific requests and release their buffers — the windowed
+        write pipeline: group g-1's write buffer is freed while group g
+        computes, so host RAM holds ~one group, not the whole state."""
+        for key in keys:
+            req = self._inflight.pop(key, None)
+            if req is None:
+                continue
+            rc = self._lib.dstpu_aio_wait(self._h, req)
+            buf = self._buffers.pop(key, None)
+            if buf is not None and rc != buf.nbytes:
+                raise OSError(
+                    f"NVMe swap io for {key} returned {rc}, expected {buf.nbytes}"
+                )
+
     # -------------------------------------------------------------- read path
     def prefetch(self, key: str, shape, dtype) -> None:
         """Issue an async read ahead of use (reference pipelined swapper)."""
@@ -105,12 +120,22 @@ class AsyncTensorSwapper:
             raise OSError(f"NVMe swap read of {key} returned {rc}, expected {buf.nbytes}")
         return buf
 
+    def prefetch_tree(self, prefix: str, template: Any) -> None:
+        """Issue async reads for every leaf of a tree not already in flight
+        (the pipelined swapper's look-ahead, reference
+        ``pipelined_optimizer_swapper.py:52``). Template leaves need only
+        ``.shape``/``.dtype`` (arrays or ShapeDtypeStructs)."""
+        for path, leaf in jax.tree_util.tree_flatten_with_path(template)[0]:
+            key = prefix + jax.tree_util.keystr(path)
+            if key not in self._inflight:
+                self.prefetch(key, tuple(leaf.shape), leaf.dtype)
+
     def swap_in_tree(self, prefix: str, template: Any) -> Any:
         flat, treedef = jax.tree_util.tree_flatten_with_path(template)
         for path, leaf in flat:
             key = prefix + jax.tree_util.keystr(path)
             if key not in self._inflight:
-                self.prefetch(key, np.asarray(leaf).shape, np.asarray(leaf).dtype)
+                self.prefetch(key, tuple(leaf.shape), leaf.dtype)
         leaves = [
             self.swap_in(prefix + jax.tree_util.keystr(path))
             for path, _ in flat

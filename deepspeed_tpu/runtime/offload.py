@@ -18,19 +18,46 @@ NVMe tier (ZeRO-Infinity): see ``runtime/nvme_swap.py``.
 from __future__ import annotations
 
 import jax
+import numpy as np
 
 HOST_MEMORY = "pinned_host"
 DEVICE_MEMORY = "device"
 
 
-def supports_memory_kinds() -> bool:
-    """Host memory kinds exist on TPU/GPU backends; CPU backend has no tiers."""
+_MEMORY_KIND_PROBE: dict = {}
+
+
+def supports_memory_kinds(mesh=None) -> bool:
+    """Whether a pinned-host tier actually WORKS here.
+
+    Listing ``pinned_host`` in ``addressable_memories()`` is not enough: some
+    backends (e.g. multi-device CPU) advertise the kind but the SPMD
+    partitioner rejects host-placement annotations at compile time. So probe
+    functionally: compile a tiny program that emits a host-kind output on the
+    given mesh (capability-probe pattern, like the XLA-flag probing)."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    key = tuple(sorted(mesh.shape.items())) if mesh is not None else None
+    if key in _MEMORY_KIND_PROBE:
+        return _MEMORY_KIND_PROBE[key]
+    ok = False
     try:
         dev = jax.devices()[0]
-        memories = {m.kind for m in dev.addressable_memories()}
-        return HOST_MEMORY in memories
+        if HOST_MEMORY in {m.kind for m in dev.addressable_memories()}:
+            if mesh is None:
+                ok = True
+            else:
+                axis = next(iter(mesh.shape))
+                sh = NamedSharding(mesh, PartitionSpec(axis),
+                                   memory_kind=HOST_MEMORY)
+                n = int(np.prod(list(mesh.shape.values())))
+                jax.jit(lambda: jnp.zeros((n,)), out_shardings=sh)()
+                ok = True
     except Exception:
-        return False
+        ok = False
+    _MEMORY_KIND_PROBE[key] = ok
+    return ok
 
 
 def to_host_kind(sharding):
@@ -58,3 +85,26 @@ def stream_out(tree, host_shardings):
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), tree, host_shardings
     )
+
+
+def partition_groups(leaf_sizes: list[int], max_elements: int) -> list[list[int]]:
+    """Greedy-pack leaf indices into sub-groups of ~``max_elements`` elements.
+
+    The windowing unit of offloaded optimizer state (reference stage-3
+    ``sub_group_size``, ``stage3.py:2360 _prepare_sub_group``): the engine
+    updates one group at a time so only ~1/n_groups of the state is ever
+    resident in HBM (host tier) or host DRAM (NVMe tier). Leaves keep their
+    original order; a leaf larger than ``max_elements`` gets its own group.
+    """
+    groups: list[list[int]] = []
+    cur: list[int] = []
+    cur_size = 0
+    for i, size in enumerate(leaf_sizes):
+        if cur and cur_size + size > max_elements:
+            groups.append(cur)
+            cur, cur_size = [], 0
+        cur.append(i)
+        cur_size += size
+    if cur:
+        groups.append(cur)
+    return groups

@@ -62,11 +62,48 @@ def safe_set_full_fp32_param(engine, name: str, value) -> None:
     _set(engine.params, name, new)
 
 
+def _param_leaf_index(engine, name: str) -> int:
+    """Flat leaf index of a named parameter (grouped-offload addressing)."""
+    target, _ = _walk(engine.params, name)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(engine.params)):
+        if leaf is target:
+            return i
+    raise KeyError(f"parameter {name!r} not found")
+
+
+def _state_tuple_leaf(state, state_name: str, j: int):
+    """The j-th tuple entry of the ``state_name`` field in a grouped optax
+    state (grouped states hold moments as tuples of leaves)."""
+    for element in jax.tree_util.tree_leaves(
+        state, is_leaf=lambda x: hasattr(x, state_name)
+    ):
+        if hasattr(element, state_name):
+            return getattr(element, state_name)[j]
+    raise KeyError(f"no optimizer state {state_name!r} found")
+
+
 def safe_get_full_optimizer_state(engine, name: str, state_name: str = "mu") -> np.ndarray:
     """Full value of an optimizer moment for a parameter (``exp_avg`` ->
-    ``mu``, ``exp_avg_sq`` -> ``nu`` in optax terms; both aliases accepted)."""
+    ``mu``, ``exp_avg_sq`` -> ``nu`` in optax terms; both aliases accepted).
+
+    Works across all optimizer-state representations: the plain full tree,
+    host-tier sub-groups (list of per-group states over leaf tuples), and
+    NVMe-resident groups (read back through the swapper)."""
     alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
     state_name = alias.get(state_name, state_name)
+
+    mode = getattr(engine, "_offload_mode", None)
+    if mode is not None:
+        i = _param_leaf_index(engine, name)
+        g = next(gi for gi, idx in enumerate(engine._groups) if i in idx)
+        j = engine._groups[g].index(i)
+        if mode == "nvme":
+            state = engine._swapper.swap_in_tree(
+                f"opt_g{g}", engine._nvme_templates[g])
+        else:
+            state = engine.opt_state[g]
+        return np.asarray(_state_tuple_leaf(state, state_name, j))
+
     for element in jax.tree_util.tree_leaves(
         engine.opt_state, is_leaf=lambda x: hasattr(x, state_name)
     ):

@@ -1,0 +1,131 @@
+"""Quantized collectives (ZeRO++ qgZ / 1-bit comm) — int8 on the wire,
+error-feedback convergence, engine training parity
+(reference: ``tests/unit/comm``, ``tests/unit/runtime/comm`` + onebit suites)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.comm import init_distributed
+from deepspeed_tpu.comm.quantized_collectives import quantized_all_reduce_arrays
+from deepspeed_tpu.comm.topology import reset_topology
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.models import llama
+
+VOCAB = 256
+
+
+@pytest.fixture
+def data_mesh():
+    return init_distributed(MeshConfig(data=8)).mesh
+
+
+class TestQuantizedAllReduce:
+    def test_mean_within_quantization_tolerance(self, data_mesh):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(8, 1000)).astype(np.float32))
+        err = jnp.zeros_like(x)
+        mean, _ = jax.jit(
+            lambda x, e: quantized_all_reduce_arrays(x, e, data_mesh, "data")
+        )(x, err)
+        true = np.asarray(x).mean(axis=0)
+        rel = np.abs(np.asarray(mean)[0] - true).max() / np.abs(true).max()
+        assert rel < 0.02, rel
+
+    def test_error_feedback_kills_bias(self, data_mesh):
+        """Averaging repeated reductions of the SAME tensor must converge to
+        the exact mean — the error-feedback property 1-bit Adam relies on."""
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(8, 512)).astype(np.float32))
+        e = jnp.zeros_like(x)
+        f = jax.jit(lambda x, e: quantized_all_reduce_arrays(x, e, data_mesh, "data"))
+        acc = np.zeros(512)
+        n = 40
+        for _ in range(n):
+            m, e = f(x, e)
+            acc += np.asarray(m)[0]
+        true = np.asarray(x).mean(axis=0)
+        one_shot = np.abs(np.asarray(f(x, jnp.zeros_like(x))[0])[0] - true).max()
+        with_ef = np.abs(acc / n - true).max()
+        assert with_ef < one_shot / 5, (with_ef, one_shot)
+
+    def test_wire_dtype_is_int8(self, data_mesh):
+        """The VERDICT 'done' criterion: the collective operands in the
+        compiled HLO are s8, i.e. compression happens ON THE WIRE, not just
+        numerically."""
+        x = jnp.zeros((8, 256), jnp.float32)
+        f = jax.jit(lambda x, e: quantized_all_reduce_arrays(x, e, data_mesh, "data"))
+        txt = f.lower(x, jnp.zeros_like(x)).compile().as_text()
+        a2a = [l for l in txt.splitlines() if "all-to-all" in l]
+        ag = [l for l in txt.splitlines() if "all-gather" in l]
+        assert a2a and any("s8[" in l for l in a2a), "all-to-all payload not int8"
+        assert ag and any("s8[" in l for l in ag), "all-gather payload not int8"
+
+
+def _train(config_extra, optimizer=None, steps=6, seed=3):
+    reset_topology()
+    cfg = {
+        "train_micro_batch_size_per_device": 2,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 0,
+        "gradient_clipping": 1.0,
+        "optimizer": optimizer or {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1, **config_extra},
+        "mesh": {"data": 8},
+        "seed": 7,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(llama.LlamaConfig.tiny(VOCAB), ctx=ctx),
+        config=cfg, seed=11,
+    )
+    rng = np.random.default_rng(seed)
+    batch = {"input_ids": rng.integers(0, VOCAB, (32, 16), dtype=np.int32)}
+    return [float(engine.train_batch(batch)) for _ in range(steps)]
+
+
+class TestQuantizedTraining:
+    def test_convergence_parity_vs_exact_reduction(self):
+        """qgZ-compressed training must track the exact-reduction trajectory
+        closely (not bit-exact — int8 wire — but convergent and close)."""
+        base = _train({})
+        quant = _train({"quantized_gradients": True})
+        assert quant[-1] < quant[0] * 0.8  # converges
+        np.testing.assert_allclose(quant, base, rtol=0.06)
+
+    def test_requires_pure_dp_mesh(self):
+        reset_topology()
+        with pytest.raises(ValueError, match="data-parallel"):
+            deepspeed_tpu.initialize(
+                model=lambda ctx: llama.build(llama.LlamaConfig.tiny(VOCAB), ctx=ctx),
+                config={
+                    "train_micro_batch_size_per_device": 2,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1, "quantized_gradients": True},
+                    "mesh": {"data": 2, "fsdp": 4},
+                },
+            )
+
+
+class TestOnebitAdam:
+    def test_matches_adamw_during_warmup(self):
+        """With freeze_step beyond the run, 1-bit Adam IS Adam(W wd=0)."""
+        adam = _train({}, optimizer={"type": "adam", "params": {"lr": 1e-2}})
+        onebit = _train({}, optimizer={
+            "type": "onebit_adam",
+            "params": {"lr": 1e-2, "freeze_step": 1000},
+        })
+        np.testing.assert_allclose(onebit, adam, rtol=1e-4)
+
+    def test_frozen_variance_with_quantized_comm_converges(self):
+        """The full 1-bit Adam recipe: warmup with exact stats, then frozen
+        variance + compressed gradient communication."""
+        losses = _train(
+            {"quantized_gradients": True},
+            optimizer={"type": "onebit_adam",
+                       "params": {"lr": 3e-3, "freeze_step": 5}},
+            steps=10,
+        )
+        # keeps descending THROUGH the freeze point (step 5)
+        assert losses[-1] < losses[5] < losses[0] * 0.85, losses
