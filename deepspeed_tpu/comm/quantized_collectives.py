@@ -43,6 +43,12 @@ def quantized_all_reduce(x, axis_name: str, error=None, bits: int = 8,
     stays local, and the owner-segment second-stage error is re-injected
     scaled by the axis size (LOCO) so the *mean* converges.
     """
+    if bits != 8:
+        raise NotImplementedError(
+            "quantized_all_reduce supports bits=8 only (int4 payloads are "
+            "nibble-packed by the quantizer, incompatible with this reducer's "
+            "inline dequantization layout)"
+        )
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     shape = x.shape

@@ -228,6 +228,14 @@ class PipelineConfig(ConfigBase):
     num_microbatches: int = 0  # 0 => use gradient_accumulation_steps
     partition_method: str = "uniform"  # uniform | parameters
     activation_checkpoint_interval: int = 0
+    # gpipe: collective forward pipeline + autodiff backward (O(M) stashes)
+    # 1f1b:  interleaved schedule, P-deep stash, composes with fsdp
+    #        (reference schedule.py:189 TrainSchedule)
+    schedule: str = "gpipe"
+
+    def _validate(self, path: str = "") -> None:
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ConfigError(f"{path}schedule: must be gpipe|1f1b")
 
 
 @dataclass

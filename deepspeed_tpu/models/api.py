@@ -157,6 +157,9 @@ class ModelSpec:
     #   -> (logits [T, V], cache)
     init_paged_cache_fn: Callable | None = None
     ragged_forward_fn: Callable | None = None
+    # 1F1B pipeline decomposition (parallel/pipeline_1f1b.py): the tuple
+    # (stage0_fn, block_fn, last_fn, split_fn, merge_fn) itself
+    pipeline_parts: Any = None
 
 
 def causal_lm_loss(

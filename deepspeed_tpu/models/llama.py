@@ -329,6 +329,43 @@ def ragged_forward(cfg: LlamaConfig, params, tokens, slots, positions,
     return logits, {"k": new_k, "v": new_v}
 
 
+# ------------------------------------------------------------------ pipeline
+def pipeline_parts(cfg: LlamaConfig, ctx: ShardCtx | None = None,
+                   attn_impl: str = "auto"):
+    """Stage decomposition for the 1F1B schedule
+    (``parallel/pipeline_1f1b.py``): embedding on stage 0, the scanned layer
+    block per stage, final-norm + head + loss on the last stage (reference
+    ``PipelineModule`` places loss_fn on the last stage).
+
+    Returns ``(stage0_fn, block_fn, last_fn, split_fn, merge_fn)``.
+    """
+    ctx = ctx or ShardCtx()
+
+    def split_fn(params):
+        extras = {k: v for k, v in params.items() if k != "layers"}
+        return params["layers"], extras
+
+    def merge_fn(layer_grads, extras_grads):
+        return {**extras_grads, "layers": layer_grads}
+
+    def stage0_fn(extras, mb):
+        x = extras["embed"][mb["input_ids"]]
+        return ctx.constrain(x, "batch", "seq", "embed_act")
+
+    def block_fn(layer_slice, extras, x):
+        del extras
+        layer = partial(_decoder_layer, cfg, ctx, attn_impl)
+        return lax.scan(lambda c, lp: (layer(c, lp), None), x, layer_slice)[0]
+
+    def last_fn(extras, y, mb):
+        x = rmsnorm(y, extras["final_norm"], cfg.rms_norm_eps)
+        head = (extras["embed"].T if cfg.tie_embeddings
+                else extras["lm_head"]).astype(x.dtype)
+        return causal_lm_loss(x @ head, mb["input_ids"], mb.get("labels"))
+
+    return stage0_fn, block_fn, last_fn, split_fn, merge_fn
+
+
 def num_params(cfg: LlamaConfig) -> int:
     d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.hd
     per_layer = d * hd * (cfg.num_heads * 2 + cfg.num_kv_heads * 2) + 3 * d * f + 2 * d
@@ -384,4 +421,5 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         decode_fn=partial(decode_forward, cfg, ctx=ctx),
         init_paged_cache_fn=partial(init_paged_cache, cfg),
         ragged_forward_fn=partial(ragged_forward, cfg),
+        pipeline_parts=pipeline_parts(cfg, ctx=ctx, attn_impl=attn_impl),
     )

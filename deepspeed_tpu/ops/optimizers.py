@@ -95,6 +95,12 @@ def scale_by_onebit_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
     import jax
     import jax.numpy as jnp
 
+    if warmup_steps < 1:
+        raise ValueError(
+            "onebit_adam freeze_step must be >= 1 (the variance estimate "
+            "needs at least one warmup step)"
+        )
+
     def init(params):
         mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
         nu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)

@@ -61,19 +61,31 @@ def _spec_for_param(
     use_tp: bool,
     dim_units: dict,
     persistence_threshold: int,
+    pp_fsdp: bool = False,
 ) -> PartitionSpec:
     assign: list = [None] * len(shape)
     size = 1
     for s in shape:
         size *= s
-    # Pipelined layer stacks shard ONLY on the pipeline axis: within a stage the
-    # layer weights must be whole (the stage body runs as manual SPMD), so
-    # TP/fsdp are not applied to them — mirroring the reference's PP (x)
-    # ZeRO<=1 composition constraint (runtime/pipe + zero stage checks).
+    # Pipelined layer stacks always shard the layer dim on the pipeline axis.
+    # Under the GPipe collective pipeline the stage body is fully-manual SPMD,
+    # so within a stage the weights must be whole (no TP/fsdp) — the
+    # reference's PP (x) ZeRO<=1 composition constraint. The 1F1B schedule is
+    # manual over `pipeline` ONLY, leaving fsdp GSPMD-auto inside the stage
+    # block, so fsdp sharding of the stacked weights is allowed there
+    # (pp_fsdp=True, set when pipeline.schedule == "1f1b").
     if topo.size(AXIS_PIPE) > 1 and "layers" in axes:
         i = axes.index("layers")
         if shape[i] % topo.size(AXIS_PIPE) == 0:
             assign[i] = AXIS_PIPE
+        if pp_fsdp and shard_params_fsdp:
+            fsdp_n = topo.size(AXIS_FSDP)
+            if fsdp_n > 1 and size > persistence_threshold:
+                cands = [j for j in range(len(shape))
+                         if assign[j] is None and axes[j] not in _FSDP_EXCLUDED
+                         and shape[j] % fsdp_n == 0]
+                if cands:
+                    assign[max(cands, key=lambda j: shape[j])] = AXIS_FSDP
         return PartitionSpec(*assign)
     for i, logical in enumerate(axes):
         if logical is None:
@@ -156,6 +168,7 @@ def plan_sharding(
     use_tp: bool = True,
     dim_units: dict | None = None,
     persistence_threshold: int = 0,
+    pp_fsdp: bool = False,
 ) -> ShardingPlan:
     """Build the full sharding plan for a model's parameter pytree.
 
@@ -177,7 +190,8 @@ def plan_sharding(
     def build(shard_fsdp: bool):
         specs = [
             _spec_for_param(
-                ax, tuple(p.shape), topo, shard_fsdp, use_tp, dim_units, persistence_threshold
+                ax, tuple(p.shape), topo, shard_fsdp, use_tp, dim_units,
+                persistence_threshold, pp_fsdp=pp_fsdp,
             )
             for ax, p in zip(axes_leaves, param_leaves)
         ]

@@ -1,0 +1,222 @@
+"""1F1B pipeline schedule inside one jit program.
+
+Role parity with the reference ``runtime/pipe/schedule.py:189 TrainSchedule``
+(non-interleaved 1F1B: each stage warms up with P-1-s forwards, then
+alternates one-forward-one-backward, then drains) — the schedule that bounds
+in-flight activations at P microbatches instead of GPipe's M.
+
+TPU-native expression: no instruction interpreter — one ``lax.scan`` over
+``2M + 2(P-1)`` slots inside a shard_map that is manual over the ``pipeline``
+axis ONLY. Slot membership is closed-form:
+
+    warmup  F of microbatch i at slot t = s + i          (i < P - s)
+    steady  F of microbatch i at slot t = 2i + s         (i >= P - s)
+    B       of microbatch j at slot t = 2j + 2P - 1 - s
+
+F and B slots have opposite parity in steady state, so each slot runs at most
+one of them (a 2-way ``lax.cond``). The backward recomputes the stage block
+from the stashed stage INPUT via ``jax.vjp`` (activation remat), so per-stage
+activation memory is a P-deep ring of stage inputs — the 1F1B bound.
+
+Because only ``pipeline`` is manual, every other mesh axis (fsdp/tensor/
+data/...) stays GSPMD-auto inside the body: stage parameters may be
+fsdp-sharded and XLA inserts the gather/reduce-scatter around the stage block
+— the PP x ZeRO composition the reference reaches via groups plumbing.
+
+The loss head runs ON the last stage (reference ``PipelineModule`` puts
+``loss_fn`` there) and the embedding on stage 0, so the backward seeds itself
+— no separate full-model forward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.topology import AXIS_PIPE
+
+tree_map = jax.tree_util.tree_map
+
+
+def bubble_fraction(n_stages: int, num_microbatches: int) -> float:
+    """Idle fraction of each stage's timeline: 2(P-1) of 2M + 2(P-1) slots."""
+    p, m = n_stages, num_microbatches
+    return (2 * (p - 1)) / (2 * m + 2 * (p - 1))
+
+
+def _zeros_like_tree(t):
+    return tree_map(jnp.zeros_like, t)
+
+
+def _select(pred, a, b):
+    return tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def pipeline_train_grads(
+    stage0_fn,      # (extras, mb_in) -> x          (embedding etc.)
+    block_fn,       # (layer_slice, extras, x) -> y (this stage's L/P layers)
+    last_fn,        # (extras, y, mb_tgt) -> scalar loss for the microbatch
+    stacked_params,  # leaves [L, ...]
+    extras,          # non-layer params (embed/head/norms), replicated
+    mb_in,           # pytree, leaves [M, ...] microbatched inputs
+    mb_tgt,          # pytree, leaves [M, ...] microbatched targets
+    mesh,
+):
+    """Full fwd+bwd under the 1F1B schedule.
+
+    Returns ``(mean_loss, stacked_param_grads, extras_grads)`` — gradients of
+    ``mean over microbatches of last_fn``, exactly matching autodiff of the
+    unpipelined model.
+    """
+    n_stages = int(mesh.shape.get(AXIS_PIPE, 1))
+    m = jax.tree_util.tree_leaves(mb_in)[0].shape[0]
+    if m < n_stages:
+        raise ValueError(f"1F1B needs microbatches ({m}) >= stages ({n_stages})")
+
+    def local(stacked_local, extras, mb_in, mb_tgt):
+        s = lax.axis_index(AXIS_PIPE)
+        p = n_stages
+        slots = 2 * m + 2 * (p - 1)
+        is_first = s == 0
+        is_last = s == p - 1
+
+        # probe shapes: what a stage input/output looks like (one microbatch)
+        mb0 = tree_map(lambda a: a[0], mb_in)
+        x_shape = jax.eval_shape(stage0_fn, extras, mb0)
+        x0 = tree_map(lambda sd: jnp.zeros(sd.shape, sd.dtype), x_shape)
+
+        stash0 = tree_map(
+            lambda a: jnp.zeros((p,) + a.shape, a.dtype), x0)
+        acc_layers0 = _zeros_like_tree(stacked_local)
+        acc_extras0 = _zeros_like_tree(extras)
+        fwd_perm = [(i, (i + 1) % p) for i in range(p)]
+        bwd_perm = [(i, (i - 1) % p) for i in range(p)]
+
+        def fwd_only(x):
+            return block_fn(stacked_local, extras, x)
+
+        def slot(carry, t):
+            recv_f, recv_b, stash, accl, acce, loss_acc = carry
+
+            # ---- schedule membership (closed form above)
+            i_w = t - s                      # warmup F index
+            f_warm = (i_w >= 0) & (i_w < jnp.minimum(m, p - s))
+            even = ((t - s) % 2) == 0
+            i_s = (t - s) // 2               # steady F index
+            f_steady = even & (i_s >= p - s) & (i_s < m)
+            do_f = f_warm | f_steady
+            fi = jnp.clip(jnp.where(f_warm, i_w, i_s), 0, m - 1)
+
+            tb = t - (2 * p - 1 - s)
+            do_b = (tb >= 0) & (tb % 2 == 0) & (tb // 2 < m)
+            bj = jnp.clip(tb // 2, 0, m - 1)
+
+            # ---- F branch
+            def run_f(ops):
+                stash, loss_acc = ops
+                mb_i = tree_map(lambda a: lax.dynamic_index_in_dim(
+                    a, fi, 0, keepdims=False), mb_in)
+                x_in = _select(is_first, stage0_fn(extras, mb_i), recv_f)
+                y = fwd_only(x_in)
+                stash = tree_map(
+                    lambda buf, v: lax.dynamic_update_index_in_dim(
+                        buf, v, fi % p, 0),
+                    stash, x_in)
+                # last stage: report the microbatch loss (value only; its
+                # gradient is recomputed at the B slot)
+                tgt_i = tree_map(lambda a: lax.dynamic_index_in_dim(
+                    a, fi, 0, keepdims=False), mb_tgt)
+                mb_loss = last_fn(extras, y, tgt_i)
+                loss_acc = loss_acc + jnp.where(is_last, mb_loss, 0.0)
+                return stash, loss_acc, y
+
+            def skip_f(ops):
+                stash, loss_acc = ops
+                return stash, loss_acc, x0
+
+            stash, loss_acc, y_out = lax.cond(
+                do_f, run_f, skip_f, (stash, loss_acc))
+
+            # ---- B branch (recompute from stashed input + vjp)
+            def run_b(ops):
+                accl, acce = ops
+                x_j = tree_map(lambda buf: lax.dynamic_index_in_dim(
+                    buf, bj % p, 0, keepdims=False), stash)
+                tgt_j = tree_map(lambda a: lax.dynamic_index_in_dim(
+                    a, bj, 0, keepdims=False), mb_tgt)
+
+                mb_j = tree_map(lambda a: lax.dynamic_index_in_dim(
+                    a, bj, 0, keepdims=False), mb_in)
+
+                def last_stage_loss(lp, e, x):
+                    return last_fn(e, block_fn(lp, e, x), tgt_j)
+
+                def mid_stage(lp, e, x):
+                    return block_fn(lp, e, x)
+
+                def first_stage(lp, e):
+                    # include the embedding so its extras get gradients
+                    return block_fn(lp, e, stage0_fn(e, mb_j))
+
+                def b_last(_):
+                    _, vjp = jax.vjp(last_stage_loss, stacked_local, extras, x_j)
+                    return vjp(jnp.float32(1.0) / m)
+
+                def b_first(_):
+                    _, vjp = jax.vjp(first_stage, stacked_local, extras)
+                    gl, ge = vjp(recv_b)
+                    return gl, ge, x0
+
+                def b_mid(_):
+                    _, vjp = jax.vjp(mid_stage, stacked_local, extras, x_j)
+                    return vjp(recv_b)
+
+                gl, ge, gx = lax.cond(
+                    is_last, b_last,
+                    lambda op: lax.cond(is_first, b_first, b_mid, op), None)
+                accl = tree_map(jnp.add, accl, gl)
+                acce = tree_map(jnp.add, acce, ge)
+                return accl, acce, gx
+
+            def skip_b(ops):
+                accl, acce = ops
+                return accl, acce, x0
+
+            accl, acce, gx_out = lax.cond(do_b, run_b, skip_b, (accl, acce))
+
+            # ---- stage transfer: activations forward, gradients backward.
+            # A receive buffer is only REPLACED when the sender actually
+            # computed that slot (the did-flag travels with the payload);
+            # otherwise it holds its value across the sender's idle slots
+            # (e.g. the warmup->steady seam).
+            sent_f = lax.ppermute(do_f.astype(jnp.float32), AXIS_PIPE, fwd_perm)
+            got_f = tree_map(lambda v: lax.ppermute(v, AXIS_PIPE, fwd_perm),
+                             y_out)
+            recv_f = _select(sent_f > 0, got_f, recv_f)
+            sent_b = lax.ppermute(do_b.astype(jnp.float32), AXIS_PIPE, bwd_perm)
+            got_b = tree_map(lambda v: lax.ppermute(v, AXIS_PIPE, bwd_perm),
+                             gx_out)
+            recv_b = _select(sent_b > 0, got_b, recv_b)
+            return (recv_f, recv_b, stash, accl, acce, loss_acc), None
+
+        carry0 = (x0, x0, stash0, acc_layers0, acc_extras0, jnp.float32(0.0))
+        (_, _, _, accl, acce, loss_acc), _ = lax.scan(
+            slot, carry0, jnp.arange(slots))
+
+        # losses live on the last stage, extras grads are partial per stage
+        loss = lax.psum(jnp.where(is_last, loss_acc, 0.0), AXIS_PIPE) / m
+        acce = tree_map(lambda g: lax.psum(g, AXIS_PIPE), acce)
+        return loss, accl, acce
+
+    param_specs = tree_map(lambda _: P(AXIS_PIPE), stacked_params)
+    rep = tree_map(lambda _: P(), extras)
+    in_rep = tree_map(lambda _: P(), mb_in)
+    tgt_rep = tree_map(lambda _: P(), mb_tgt)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(param_specs, rep, in_rep, tgt_rep),
+        out_specs=(P(), param_specs, tree_map(lambda _: P(), extras)),
+        axis_names={AXIS_PIPE}, check_vma=False,
+    )(stacked_params, extras, mb_in, mb_tgt)

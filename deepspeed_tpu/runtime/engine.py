@@ -115,6 +115,7 @@ class Engine:
             use_tp=topo.size("tensor") > 1,
             dim_units=self.model_spec.logical_dim_units,
             persistence_threshold=zero.persistence_threshold,
+            pp_fsdp=config.pipeline.schedule == "1f1b",
         )
 
         # ---- params (fp32 master), placed per plan (reference zero.Init analog)
@@ -286,6 +287,15 @@ class Engine:
             log_dist("gradient reduction: int8 quantized (qgZ) over the data "
                      f"axis (n={n}) with error feedback", ranks=[0])
 
+        if (self._offload_mode == "nvme"
+                and config.pipeline.schedule == "1f1b"
+                and topo.size("pipeline") > 1):
+            raise ValueError(
+                "pipeline.schedule='1f1b' is not supported with NVMe-offloaded "
+                "optimizer state (the NVMe step path uses the GPipe grads "
+                "program); use offload_optimizer.device=cpu or schedule=gpipe"
+            )
+
         self._train_batch_jit = None
         self._accum_jit = None
         self._apply_jit = None
@@ -428,6 +438,9 @@ class Engine:
     def _build_train_batch_fn(self):
         if self._qgrad:
             return self._build_train_batch_fn_qgrad()
+        if (self.topo.size("pipeline") > 1
+                and self.config.pipeline.schedule == "1f1b"):
+            return self._build_train_batch_fn_1f1b()
 
         def train_batch_fn(params, opt_state, scale_state, step, base_rng, batch):
             loss, acc = self._gas_grads(params, scale_state, step, base_rng, batch)
@@ -496,6 +509,61 @@ class Engine:
         ZeRO-Infinity step splits there so the update can walk NVMe-resident
         sub-groups on the host."""
         return jax.jit(self._gas_grads)
+
+    def _build_train_batch_fn_1f1b(self):
+        """Fused step under the 1F1B pipeline schedule (reference
+        ``schedule.py:189 TrainSchedule`` / ``PipelineEngine.train_batch``):
+        GAS microbatches ARE the pipeline microbatches; fwd+bwd run manually
+        interleaved inside ``parallel/pipeline_1f1b.py`` and the optimizer
+        tail is shared with every other path."""
+        from deepspeed_tpu.parallel.pipeline_1f1b import pipeline_train_grads
+
+        parts = self.model_spec.pipeline_parts
+        if parts is None:
+            raise ValueError(
+                f"model {self.model_spec.name} provides no pipeline_parts; "
+                "the 1f1b schedule needs a stage decomposition"
+            )
+        stage0_fn, block_fn, last_fn, split_fn, merge_fn = parts
+        if self.gas < self.topo.size("pipeline"):
+            raise ValueError(
+                f"1f1b needs gradient_accumulation_steps (= pipeline "
+                f"microbatches, {self.gas}) >= pipeline stages "
+                f"({self.topo.size('pipeline')})"
+            )
+        gas = self.gas
+
+        def train_batch_fn(params, opt_state, scale_state, step, base_rng, batch):
+            del base_rng  # no dropout in the pipelined models
+            scale = scale_state.scale
+            cparams = precision.cast_to_compute(params, self.config.compute_dtype)
+            stacked, extras = split_fn(cparams)
+
+            def last_scaled(e, y, t):
+                return last_fn(e, y, t) * scale
+
+            # sharding hints are suspended inside the manual-over-pipeline
+            # region (GSPMD still propagates the auto axes from the inputs),
+            # mirroring ShardCtx.layer_stack's GPipe handling
+            self.shard_ctx._suspend_constraints = True
+            try:
+                loss_scaled, gl, ge = pipeline_train_grads(
+                    stage0_fn, block_fn, last_scaled, stacked, extras,
+                    batch, batch, self.topo.mesh,
+                )
+            finally:
+                self.shard_ctx._suspend_constraints = False
+            # pipeline returns mean-over-microbatch grads; the shared update
+            # tail expects the GAS-summed accumulator
+            acc = self._constrain_grads(
+                jax.tree_util.tree_map(lambda g: g * gas, merge_fn(gl, ge)))
+            new_params, new_opt, new_scale, metrics = self._update(
+                params, opt_state, scale_state, acc, float(gas), step
+            )
+            metrics["loss"] = loss_scaled / scale
+            return new_params, new_opt, new_scale, metrics
+
+        return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2))
 
     def _build_group_apply_fn(self):
         """Sub-group optimizer apply: takes a group's param/grad leaf tuples +

@@ -57,6 +57,11 @@ class AsyncTensorSwapper:
     def swap_out(self, key: str, array) -> None:
         """Async write; the array is snapshotted into a swapper-owned buffer so
         the caller may free/mutate theirs immediately."""
+        # an in-flight request on the same key (e.g. a prefetch issued before
+        # an overflow-skipped step) must complete before its buffer is
+        # replaced — otherwise the AIO thread DMAs into freed memory
+        if key in self._inflight:
+            self.wait_keys([key])
         buf = np.ascontiguousarray(np.asarray(array))
         self._buffers[key] = buf  # keep alive until commit
         req = self._lib.dstpu_aio_submit_write(
@@ -100,6 +105,8 @@ class AsyncTensorSwapper:
     # -------------------------------------------------------------- read path
     def prefetch(self, key: str, shape, dtype) -> None:
         """Issue an async read ahead of use (reference pipelined swapper)."""
+        if key in self._inflight:
+            self.wait_keys([key])
         buf = np.empty(shape, dtype)
         self._buffers[key] = buf
         req = self._lib.dstpu_aio_submit_read(
@@ -132,10 +139,7 @@ class AsyncTensorSwapper:
 
     def swap_in_tree(self, prefix: str, template: Any) -> Any:
         flat, treedef = jax.tree_util.tree_flatten_with_path(template)
-        for path, leaf in flat:
-            key = prefix + jax.tree_util.keystr(path)
-            if key not in self._inflight:
-                self.prefetch(key, tuple(leaf.shape), leaf.dtype)
+        self.prefetch_tree(prefix, template)
         leaves = [
             self.swap_in(prefix + jax.tree_util.keystr(path))
             for path, _ in flat

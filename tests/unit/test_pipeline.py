@@ -60,24 +60,33 @@ def test_pipeline_grads_match_scan():
                                rtol=2e-5, atol=2e-5)
 
 
-def _cfg(mesh, n_micro=0):
-    return {
-        "train_batch_size": 64,
-        "gradient_accumulation_steps": 1,
+def _cfg(mesh, n_micro=0, gas=1, schedule="gpipe", stage=0, batch=64):
+    cfg = {
+        "train_batch_size": batch,
+        "gradient_accumulation_steps": gas,
         "steps_per_print": 0,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
-        "zero_optimization": {"stage": 0},
-        "pipeline": {"num_microbatches": n_micro},
+        "zero_optimization": {"stage": stage},
+        "pipeline": {"num_microbatches": n_micro, "schedule": schedule},
         "mesh": mesh,
         "seed": 7,
     }
+    if schedule == "1f1b":
+        # fp32 keeps the many-tick schedule fast enough on the bf16-emulating
+        # CPU test mesh (the 40s collective watchdog is real here)
+        cfg["bf16"] = {"enabled": False}
+    return cfg
 
 
-def _run(mesh, n_micro=0, n=3):
+def _run(mesh, n_micro=0, n=3, gas=1, schedule="gpipe", stage=0, batch=64,
+         schedule_base_fp32=False):
     reset_topology()
+    cfg = _cfg(mesh, n_micro, gas=gas, schedule=schedule, stage=stage, batch=batch)
+    if schedule_base_fp32:
+        cfg["bf16"] = {"enabled": False}
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=lambda ctx: llama.build(llama.LlamaConfig.tiny(VOCAB), ctx=ctx),
-        config=_cfg(mesh, n_micro),
+        config=cfg,
         seed=11,
     )
     rng = np.random.default_rng(3)
@@ -93,6 +102,26 @@ def test_pp_training_loss_parity():
     _, base = _run({"data": 8})
     _, pp = _run({"data": 4, "pipeline": 2}, n_micro=2)
     np.testing.assert_allclose(base, pp, rtol=3e-4, atol=3e-5)
+
+
+def test_pp_1f1b_training_loss_parity():
+    """1F1B engine schedule (GAS microbatches = pipeline microbatches) must
+    match the same-precision DP-only trajectory with the same GAS."""
+    _, base = _run({"data": 8}, gas=4, schedule_base_fp32=True, batch=32)
+    _, pp = _run({"data": 4, "pipeline": 2}, gas=4, schedule="1f1b", batch=32)
+    np.testing.assert_allclose(base, pp, rtol=3e-4, atol=3e-5)
+
+
+def test_pp_1f1b_composes_with_fsdp():
+    """pp=2 x fsdp=2 under ZeRO-2 with the 1F1B schedule: stacked layer
+    weights carry BOTH the pipeline and fsdp axes in the grad/opt layout and
+    the trajectory matches DP."""
+    _, base = _run({"data": 8}, gas=4, stage=2, schedule_base_fp32=True, batch=32)
+    engine, pp = _run({"data": 2, "pipeline": 2, "fsdp": 2}, gas=4,
+                      schedule="1f1b", stage=2, batch=32)
+    np.testing.assert_allclose(base, pp, rtol=3e-4, atol=3e-5)
+    spec = str(engine.plan.shard_specs["layers"]["wq"])
+    assert "pipeline" in spec and "fsdp" in spec
 
 
 def test_pp_layers_sharded_over_pipeline_axis():
