@@ -108,6 +108,22 @@ class ShardCtx:
 
         return ulysses_attention(q, k, v, self.mesh, causal=causal, impl=impl)
 
+    def embed_lookup(self, table: jnp.ndarray, ids: jnp.ndarray,
+                     *act_dims: Optional[str]) -> jnp.ndarray:
+        """Token-embedding gather with multi-chip-friendly sharding.
+
+        Replicates the (possibly vocab/fsdp-sharded) table for the lookup —
+        GSPMD otherwise keeps the gather output sharded on the embed dim and
+        falls into "involuntary full rematerialization" resharding it to the
+        activation layout — then constrains the result to ``act_dims``.
+        """
+        if self.mesh is not None and not getattr(self, "_suspend_constraints", False):
+            table = jax.lax.with_sharding_constraint(
+                table, jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec()))
+        x = table[ids]
+        return self.constrain(x, *act_dims) if act_dims else x
+
     def constrain(self, x: jnp.ndarray, *logical_dims: Optional[str]) -> jnp.ndarray:
         if self.mesh is None or getattr(self, "_suspend_constraints", False):
             return x

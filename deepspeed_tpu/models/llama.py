@@ -153,8 +153,7 @@ def hidden_states(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
                   remat_policy=None, remat: bool = False) -> jnp.ndarray:
     """[B, S] int tokens -> [B, S, D] final (post-norm) hidden states."""
     ctx = ctx or ShardCtx()
-    x = params["embed"][input_ids]
-    x = ctx.constrain(x, "batch", "seq", "embed_act")
+    x = ctx.embed_lookup(params["embed"], input_ids, "batch", "seq", "embed_act")
 
     layer = partial(_decoder_layer, cfg, ctx, attn_impl)
     if remat:
@@ -349,8 +348,8 @@ def pipeline_parts(cfg: LlamaConfig, ctx: ShardCtx | None = None,
         return {**extras_grads, "layers": layer_grads}
 
     def stage0_fn(extras, mb):
-        x = extras["embed"][mb["input_ids"]]
-        return ctx.constrain(x, "batch", "seq", "embed_act")
+        return ctx.embed_lookup(extras["embed"], mb["input_ids"],
+                                "batch", "seq", "embed_act")
 
     def block_fn(layer_slice, extras, x):
         del extras

@@ -135,8 +135,7 @@ def forward(cfg: MixtralConfig, params, input_ids, ctx: ShardCtx | None = None,
     ctx = ctx or ShardCtx()
     moe_cfg = cfg.moe_config()
     b, s = input_ids.shape
-    x = params["embed"][input_ids]
-    x = ctx.constrain(x, "batch", "seq", "embed_act")
+    x = ctx.embed_lookup(params["embed"], input_ids, "batch", "seq", "embed_act")
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
