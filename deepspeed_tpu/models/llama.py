@@ -228,7 +228,7 @@ def decode_forward(cfg: LlamaConfig, params, tokens, cache, start_pos,
     """
     ctx = ctx or ShardCtx()
     max_len = cache["k"].shape[2]
-    x = params["embed"][tokens].astype(cache["k"].dtype)
+    x = ctx.embed_lookup(params["embed"], tokens).astype(cache["k"].dtype)
 
     def body(x, lp_kv):
         lp, kc, vc = lp_kv
@@ -305,7 +305,7 @@ def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots, block_table
 
 
 def ragged_forward(cfg: LlamaConfig, params, tokens, slots, positions,
-                   block_tables, cache):
+                   block_tables, cache, ctx: ShardCtx | None = None):
     """Flat ragged step: ``[T]`` mixed tokens -> (``[T, V]`` logits, cache).
 
     Each token carries (slot, absolute position); ``block_tables``
@@ -314,7 +314,8 @@ def ragged_forward(cfg: LlamaConfig, params, tokens, slots, positions,
     of prefill chunks and decodes (reference ``inference/v2/engine_v2.py:30``
     ``put()`` + ``ragged_ops`` kernels).
     """
-    x = params["embed"][tokens].astype(cache["k"].dtype)
+    ctx = ctx or ShardCtx()
+    x = ctx.embed_lookup(params["embed"], tokens).astype(cache["k"].dtype)
 
     def body(x, lp_kv):
         lp, kc, vc = lp_kv
@@ -419,6 +420,6 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         init_cache_fn=partial(init_cache, cfg),
         decode_fn=partial(decode_forward, cfg, ctx=ctx),
         init_paged_cache_fn=partial(init_paged_cache, cfg),
-        ragged_forward_fn=partial(ragged_forward, cfg),
+        ragged_forward_fn=partial(ragged_forward, cfg, ctx=ctx),
         pipeline_parts=pipeline_parts(cfg, ctx=ctx, attn_impl=attn_impl),
     )

@@ -1,0 +1,164 @@
+"""Floating-point (FP8/FP6/FP4) block quantization.
+
+Role parity with the reference ``csrc/fp_quantizer`` (``fp_quantize.cpp`` /
+``fp_quantize_impl.cu`` — FP6-LLM-style weight quantization to low-bit float
+grids with per-block scales).
+
+TPU-native expression: FP8 uses the MXU-native ``float8_e4m3fn`` /
+``float8_e5m2`` dtypes directly (ml_dtypes); FP6/FP4 have no hardware dtype,
+so they quantize onto the exact e3m2 / e2m1 value grid while storing int8
+codes — the grid math is sign/exponent/mantissa rounding in pure jnp, so
+encode/decode jit and fuse. Per-block absmax scaling matches the reference's
+quantization group semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+# (exponent bits, mantissa bits) per format; fp8 formats also have a native dtype
+_FORMATS = {
+    "fp8_e4m3": (4, 3),
+    "fp8_e5m2": (5, 2),
+    "fp6_e3m2": (3, 2),
+    "fp4_e2m1": (2, 1),
+}
+_NATIVE = {
+    "fp8_e4m3": jnp.float8_e4m3fn,
+    "fp8_e5m2": jnp.float8_e5m2,
+}
+
+
+# formats whose top exponent encodes inf/nan (IEEE-style); e4m3fn and the
+# emulated fp6/fp4 grids use their full exponent range (finite-only grids)
+_IEEE_INF_FORMATS = {"fp8_e5m2"}
+
+
+def _grid_max(fmt: str) -> float:
+    """Largest finite magnitude of the format's (sign, e, m) grid."""
+    exp_bits, man_bits = _FORMATS[fmt]
+    bias = 2 ** (exp_bits - 1) - 1
+    if fmt in _IEEE_INF_FORMATS:
+        max_exp = (2 ** exp_bits - 2) - bias          # top binade = inf/nan
+        max_man = 2 - 2.0 ** (-man_bits)
+    elif fmt == "fp8_e4m3":
+        max_exp = (2 ** exp_bits - 1) - bias          # e4m3fn: NaN only at
+        max_man = 2 - 2.0 ** (1 - man_bits)           # all-ones mantissa
+    else:
+        max_exp = (2 ** exp_bits - 1) - bias
+        max_man = 2 - 2.0 ** (-man_bits)
+    return max_man * 2.0 ** max_exp
+
+
+class FPQuantizedTensor(NamedTuple):
+    values: jnp.ndarray   # native fp8 dtype, or fp32 grid values for fp6/fp4
+    scales: jnp.ndarray   # f32 per-block scales
+    shape: tuple
+    fmt: str
+    block: int
+
+
+def _to_blocks(x: jnp.ndarray, block: int):
+    flat = x.reshape(-1)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, block)
+
+
+def _round_to_grid(x: jnp.ndarray, exp_bits: int, man_bits: int, limit: float) -> jnp.ndarray:
+    """Round fp32 values (already scaled into the grid's range) onto the
+    (1, exp_bits, man_bits) float grid, round-to-nearest-even, with proper
+    subnormal handling."""
+    bias = 2 ** (exp_bits - 1) - 1
+    sign = jnp.sign(x)
+    mag = jnp.abs(x).astype(jnp.float32)
+    # exponent of each value, clamped to the grid's representable binades
+    # (the top binade comes from `limit`, which already accounts for
+    # inf/nan-reserved encodings)
+    e = jnp.floor(jnp.log2(jnp.maximum(mag, 1e-45)))
+    e = jnp.clip(e, 1 - bias, math.floor(math.log2(limit)))
+    # quantum = distance between representable values in this binade
+    quantum = jnp.exp2(e - man_bits)
+    q = jnp.round(mag / quantum) * quantum
+    return sign * jnp.clip(q, 0.0, limit)
+
+
+def _encode_codes(v: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Grid-exact fp32 values -> int8 sign/exponent/mantissa bit codes (the
+    low-bit storage the reference fp_quantizer produces; fp6/fp4 codes occupy
+    the low 1+e+m bits of each byte)."""
+    bias = 2 ** (exp_bits - 1) - 1
+    s = (v < 0).astype(jnp.int32)
+    mag = jnp.abs(v)
+    sub_limit = 2.0 ** (1 - bias)
+    is_norm = mag >= sub_limit
+    e_val = jnp.clip(jnp.floor(jnp.log2(jnp.maximum(mag, 1e-45))), 1 - bias, None)
+    E = jnp.where(is_norm, e_val + bias, 0).astype(jnp.int32)
+    M = jnp.where(
+        is_norm,
+        jnp.round((mag / jnp.exp2(e_val) - 1.0) * 2.0 ** man_bits),
+        jnp.round(mag / (sub_limit * 2.0 ** (-man_bits))),
+    ).astype(jnp.int32)
+    # mantissa overflow from top-binade clipping: saturate
+    M = jnp.clip(M, 0, 2 ** man_bits - 1)
+    return ((s << (exp_bits + man_bits)) | (E << man_bits) | M).astype(jnp.int8)
+
+
+def _decode_codes(codes: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    bias = 2 ** (exp_bits - 1) - 1
+    c = codes.astype(jnp.int32)
+    s = (c >> (exp_bits + man_bits)) & 1
+    E = (c >> man_bits) & (2 ** exp_bits - 1)
+    M = c & (2 ** man_bits - 1)
+    mf = M.astype(jnp.float32) * 2.0 ** (-man_bits)
+    mag = jnp.where(
+        E > 0,
+        (1.0 + mf) * jnp.exp2(E.astype(jnp.float32) - bias),
+        mf * 2.0 ** (1 - bias),
+    )
+    return jnp.where(s == 1, -mag, mag)
+
+
+def fp_quantize(x: jnp.ndarray, fmt: str = "fp8_e4m3",
+                block: int = 256) -> FPQuantizedTensor:
+    """Blockwise-scaled quantization onto a low-bit float grid."""
+    if fmt not in _FORMATS:
+        raise ValueError(f"unknown format {fmt!r} (choose from {sorted(_FORMATS)})")
+    exp_bits, man_bits = _FORMATS[fmt]
+    blocks = _to_blocks(x.astype(jnp.float32), block)
+    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    limit = _grid_max(fmt)
+    scale = jnp.maximum(absmax, 1e-30) / limit
+    scaled = blocks / scale
+    if fmt in _NATIVE:
+        vals = scaled.astype(_NATIVE[fmt])  # hardware rounding + storage
+    else:
+        grid = _round_to_grid(scaled, exp_bits, man_bits, limit)
+        vals = _encode_codes(grid, exp_bits, man_bits)  # int8 bit codes
+    return FPQuantizedTensor(values=vals, scales=scale[:, 0],
+                             shape=tuple(x.shape), fmt=fmt, block=block)
+
+
+def fp_dequantize(qt: FPQuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
+    if qt.fmt in _NATIVE:
+        grid = qt.values.astype(jnp.float32)
+    else:
+        exp_bits, man_bits = _FORMATS[qt.fmt]
+        grid = _decode_codes(qt.values, exp_bits, man_bits)
+    vals = grid * qt.scales[:, None]
+    flat = vals.reshape(-1)
+    size = 1
+    for s in qt.shape:
+        size *= s
+    return flat[:size].reshape(qt.shape).astype(dtype)
+
+
+def fp_quantize_dequantize(x: jnp.ndarray, fmt: str = "fp8_e4m3",
+                           block: int = 256) -> jnp.ndarray:
+    """Fake-quant round trip (QAT / accuracy-evaluation helper, reference
+    ``fake_quantizer.cu``)."""
+    return fp_dequantize(fp_quantize(x, fmt=fmt, block=block), dtype=x.dtype)

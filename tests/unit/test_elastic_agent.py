@@ -1,0 +1,99 @@
+"""Elastic agent: worker supervision, scale-down restart, preemption
+checkpointing (reference ``elasticity/elastic_agent.py`` + checkpoint-based
+recovery, SURVEY §5.3)."""
+
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity.agent import ElasticAgent, PreemptionHandler, WorkerSpec
+
+
+def _worker_cmd(tmp_path, rank, world, die_rank=None):
+    """A worker that writes its (rank, world), optionally dies once."""
+    marker = tmp_path / f"died_once_{rank}"
+    code = f"""
+import os, sys, time
+open({str(tmp_path)!r} + f"/seen_{{os.environ['RANK']}}_{{os.environ['WORLD_SIZE']}}", "w").close()
+if os.environ['RANK'] == {die_rank!r} and not os.path.exists({str(marker)!r}):
+    open({str(marker)!r}, "w").close()
+    sys.exit(17)
+time.sleep(0.2)
+"""
+    return [sys.executable, "-c", code]
+
+
+class TestElasticAgent:
+    def test_scale_down_restart(self, tmp_path):
+        """A dying worker triggers relaunch at the next admissible world size
+        with the remaining capacity."""
+
+        def make(rank, world):
+            env = dict(os.environ, RANK=str(rank), WORLD_SIZE=str(world))
+            return WorkerSpec(cmd=_worker_cmd(tmp_path, rank, world, die_rank="1"),
+                              env=env)
+
+        agent = ElasticAgent(
+            target_batch_size=32,
+            micro_batch_candidates=[1, 2, 4],
+            make_worker=make,
+            max_world_size=4,
+            poll_interval=0.1,
+        )
+        assert agent.admissible_world_sizes() == [1, 2, 4]
+        assert agent.run() == 0
+        # first wave at world=4 (rank 1 died once), second wave at world<=3 -> 2
+        assert (tmp_path / "seen_0_4").exists()
+        assert (tmp_path / "seen_0_2").exists()
+        assert not (tmp_path / "seen_0_3").exists()  # 3 inadmissible for batch 32
+
+    def test_no_admissible_size_raises(self):
+        agent = ElasticAgent(
+            target_batch_size=7,
+            micro_batch_candidates=[2],
+            make_worker=lambda r, w: WorkerSpec(cmd=["true"]),
+            max_world_size=4,
+        )
+        with pytest.raises(ValueError, match="no admissible"):
+            agent.admissible_world_sizes()
+
+
+class TestPreemptionHandler:
+    def test_sigterm_checkpoints_and_stops(self, tmp_path):
+        import deepspeed_tpu
+        from deepspeed_tpu.comm.topology import reset_topology
+        from deepspeed_tpu.models import llama
+
+        reset_topology()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=lambda ctx: llama.build(llama.LlamaConfig.tiny(256), ctx=ctx),
+            config={
+                "train_micro_batch_size_per_device": 2,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 0,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "mesh": {"data": 8},
+            },
+        )
+        handler = PreemptionHandler(engine, str(tmp_path))
+        try:
+            rng = np.random.default_rng(0)
+            steps = 0
+            for _ in range(5):
+                if handler.should_stop:
+                    break
+                engine.train_batch(
+                    {"input_ids": rng.integers(0, 256, (16, 16), dtype=np.int32)})
+                steps += 1
+                if steps == 2:  # the preemption notice arrives mid-run
+                    os.kill(os.getpid(), signal.SIGTERM)
+            path = handler.checkpoint_if_needed()
+            assert handler.should_stop and steps == 2
+            assert path is not None and (tmp_path / "preempt").is_dir()
+            assert handler.checkpoint_if_needed() is None  # at most once
+        finally:
+            handler.restore()

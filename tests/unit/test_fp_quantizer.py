@@ -1,0 +1,75 @@
+"""FP8/FP6/FP4 float-grid quantization (reference ``csrc/fp_quantizer`` +
+``tests/unit/ops/fp_quantizer``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.fp_quantizer import (
+    FPQuantizedTensor,
+    fp_dequantize,
+    fp_quantize,
+    fp_quantize_dequantize,
+)
+
+
+@pytest.fixture
+def x():
+    return jnp.asarray(
+        np.random.default_rng(0).normal(size=(64, 48)).astype(np.float32))
+
+
+@pytest.mark.parametrize("fmt,max_rel", [
+    ("fp8_e4m3", 0.07), ("fp8_e5m2", 0.30), ("fp6_e3m2", 0.30), ("fp4_e2m1", 0.60),
+])
+def test_roundtrip_error_bounded(x, fmt, max_rel):
+    """Relative error on NORMAL-range values stays within the format's
+    mantissa step (values under the block's subnormal threshold flush toward
+    zero by design — same as the reference grids)."""
+    xa = np.asarray(x)
+    y = np.asarray(fp_quantize_dequantize(x, fmt=fmt, block=64))
+    # consider elements comfortably inside each block's normal range
+    absmax = np.abs(xa.reshape(-1, 64)).max(axis=-1, keepdims=True)
+    mask = (np.abs(xa.reshape(-1, 64)) > absmax / 8).reshape(xa.shape)
+    rel = np.abs(y - xa)[mask] / np.abs(xa)[mask]
+    assert rel.max() < max_rel, (fmt, rel.max())
+
+
+def test_precision_ordering(x):
+    """More bits -> lower error (sanity that the grids differ as designed)."""
+    errs = {}
+    for fmt in ("fp8_e4m3", "fp6_e3m2", "fp4_e2m1"):
+        y = np.asarray(fp_quantize_dequantize(x, fmt=fmt, block=64))
+        errs[fmt] = float(np.abs(y - np.asarray(x)).mean())
+    assert errs["fp8_e4m3"] < errs["fp6_e3m2"] < errs["fp4_e2m1"], errs
+
+
+def test_fp8_values_are_native_dtype(x):
+    qt = fp_quantize(x, fmt="fp8_e4m3", block=64)
+    assert qt.values.dtype == jnp.float8_e4m3fn
+    assert qt.scales.dtype == jnp.float32
+
+
+def test_block_scales_isolate_outliers():
+    """A huge value in one block must not destroy precision elsewhere."""
+    v = np.ones((512,), np.float32) * 0.5
+    v[0] = 1000.0
+    y = np.asarray(fp_quantize_dequantize(jnp.asarray(v), fmt="fp8_e4m3", block=64))
+    # blocks beyond the first are exact-ish
+    np.testing.assert_allclose(y[64:], v[64:], rtol=0.05)
+
+
+def test_jittable(x):
+    # jit fusion may round grid-boundary ties differently than eager; bound
+    # the disagreement by one grid quantum instead of demanding bit equality
+    f = jax.jit(lambda t: fp_quantize_dequantize(t, fmt="fp6_e3m2", block=64))
+    a = np.asarray(f(x))
+    b = np.asarray(fp_quantize_dequantize(x, fmt="fp6_e3m2", block=64))
+    assert np.abs(a - b).max() <= np.abs(np.asarray(x)).max() * 0.25
+    np.testing.assert_array_equal(a, np.asarray(f(x)))  # deterministic
+
+
+def test_unknown_format_rejected(x):
+    with pytest.raises(ValueError, match="unknown format"):
+        fp_quantize(x, fmt="fp3_e1m1")

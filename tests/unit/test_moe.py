@@ -101,20 +101,7 @@ def test_mixtral_trains_dense_mesh():
     assert losses[-1] < losses[0], losses
 
 
-def test_expert_parallel_loss_parity():
-    """EP=4 must match the pure-DP trajectory (expert axis is a batch axis,
-    so dp_world stays 8 and the data split is identical).
-
-    Runs in a clean subprocess (the autotuner-trial / dryrun self-spawn
-    pattern): under a long-lived pytest process on this 1-core box, XLA's CPU
-    collectives can wedge when a second mesh's program follows earlier ones —
-    a runtime scheduling artifact, not a framework property (the identical
-    sequence passes standalone)."""
-    import os
-    import subprocess
-    import sys
-
-    code = """
+_ISOLATED_PREAMBLE = """
 import os
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 import jax
@@ -124,36 +111,58 @@ import deepspeed_tpu
 from deepspeed_tpu.comm.topology import reset_topology
 from deepspeed_tpu.models import mixtral
 
-def run(mesh, n=4):
+def run(mesh, n=4, stage=0):
     reset_topology()
     cfg = {"train_batch_size": 16, "gradient_accumulation_steps": 1,
            "steps_per_print": 0,
            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
-           "zero_optimization": {"stage": 0}, "mesh": mesh, "seed": 7}
+           "zero_optimization": {"stage": stage}, "mesh": mesh, "seed": 7}
     e, _, _, _ = deepspeed_tpu.initialize(
         model=lambda ctx: mixtral.build(mixtral.MixtralConfig.tiny(256), ctx=ctx),
         config=cfg, seed=11)
     r = np.random.default_rng(3)
     return [float(e.train_batch({"input_ids": r.integers(0, 256, (16, 16), np.int32)}))
             for _ in range(n)]
+"""
 
+
+def _run_isolated(body: str, marker: str) -> None:
+    """Run an EP training scenario in a clean subprocess (autotuner-trial /
+    dryrun self-spawn pattern): under a long-lived pytest process on this
+    1-core box, XLA's CPU collectives can wedge when an expert-mesh program
+    follows earlier mesh programs — a runtime scheduling artifact, not a
+    framework property (the identical sequence passes standalone)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, "-c", _ISOLATED_PREAMBLE + body], env=env,
+        capture_output=True, text=True, timeout=600, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert marker in proc.stdout
+
+
+def test_expert_parallel_loss_parity():
+    """EP=4 must match the pure-DP trajectory (expert axis is a batch axis,
+    so dp_world stays 8 and the data split is identical)."""
+    _run_isolated("""
 base = run({"data": 8})
 ep = run({"data": 2, "expert": 4})
 np.testing.assert_allclose(base, ep, rtol=3e-4, atol=3e-5)
 print("PARITY_OK")
-"""
-    env = dict(os.environ)
-    env.pop("PYTEST_CURRENT_TEST", None)
-    proc = subprocess.run([sys.executable, "-c", code], env=env,
-                          capture_output=True, text=True, timeout=600,
-                          cwd=os.path.dirname(os.path.dirname(
-                              os.path.dirname(os.path.abspath(__file__)))))
-    assert proc.returncode == 0, proc.stderr[-3000:]
-    assert "PARITY_OK" in proc.stdout
+""", "PARITY_OK")
 
 
 def test_expert_weights_sharded_over_expert_axis():
-    engine, _ = _run({"data": 2, "expert": 4}, n=1)
+    # engine init only — in-process EP *training* programs wedge XLA's CPU
+    # collectives deep into a pytest session (see the parity test's note);
+    # the sharding-plan assertion needs no step
+    engine, _ = _run({"data": 2, "expert": 4}, n=0)
     wg = engine.params["layers"]["w_gate"]
     assert "expert" in str(wg.sharding.spec)
     # 4 experts over 4-way expert axis: each device holds 1 expert's weights
@@ -161,5 +170,10 @@ def test_expert_weights_sharded_over_expert_axis():
 
 
 def test_ep_plus_zero3():
-    engine, losses = _run({"data": 1, "fsdp": 2, "expert": 4}, stage=3)
-    assert losses[-1] < losses[0]
+    """EP x fsdp ZeRO-3 training converges (subprocess-isolated, see
+    _run_isolated)."""
+    _run_isolated("""
+losses = run({"data": 1, "fsdp": 2, "expert": 4}, stage=3)
+assert losses[-1] < losses[0], losses
+print("EP_Z3_OK")
+""", "EP_Z3_OK")
