@@ -294,6 +294,32 @@ class DataEfficiencyConfig(ConfigBase):
 
 
 @dataclass
+class TracingConfig(ConfigBase):
+    """jax.profiler capture window (reference: nvtx instrumentation +
+    ``utils/nvtx.py``; traces view in TensorBoard/XProf)."""
+
+    enabled: bool = False
+    trace_dir: str = "/tmp/dstpu_trace"
+    start_step: int = 2   # skip compile steps
+    num_steps: int = 3
+
+    def _validate(self, path: str = "") -> None:
+        if self.num_steps < 1:
+            raise ConfigError(f"{path}num_steps: must be >= 1")
+
+
+@dataclass
+class DebugConfig(ConfigBase):
+    """Semantic sanity checks + NaN trapping (reference §5.2:
+    ``enable_sanity_checks``, CheckOverflow, debug-nans style checks)."""
+
+    # trap the first NaN-producing op with a traceback (jax debug_nans)
+    nans: bool = False
+    # host-side batch validation each step (shapes, dtypes, divisibility)
+    sanity_checks: bool = False
+
+
+@dataclass
 class Config(ConfigBase):
     """Top-level framework config (reference: ``DeepSpeedConfig``)."""
 
@@ -325,6 +351,8 @@ class Config(ConfigBase):
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
+    debug: DebugConfig = field(default_factory=DebugConfig)
 
     _auto_fields: ClassVar[set] = {
         "train_batch_size",

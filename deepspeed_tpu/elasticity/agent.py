@@ -116,9 +116,10 @@ class ElasticAgent:
                 if restarts > self.max_restarts:
                     log_dist("elastic agent: restart budget exhausted", ranks=[0])
                     return 1
-                # scale down: capacity shrinks by the dead workers
+                # scale down: CAPACITY shrinks by the dead workers (spare
+                # slots above the launched world size remain usable)
                 self.max_world_size = max(
-                    self.min_world_size, world - len(dead))
+                    self.min_world_size, self.max_world_size - len(dead))
                 try:
                     world = self.admissible_world_sizes()[-1]
                 except ValueError:

@@ -228,7 +228,10 @@ def decode_forward(cfg: LlamaConfig, params, tokens, cache, start_pos,
     """
     ctx = ctx or ShardCtx()
     max_len = cache["k"].shape[2]
-    x = ctx.embed_lookup(params["embed"], tokens).astype(cache["k"].dtype)
+    # plain per-row gather: decode looks up a handful of tokens per step, so
+    # embed_lookup's table replication (a training-scale fix for the gather
+    # resharding remat) would all-gather the whole table every step
+    x = params["embed"][tokens].astype(cache["k"].dtype)
 
     def body(x, lp_kv):
         lp, kc, vc = lp_kv
@@ -305,7 +308,7 @@ def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots, block_table
 
 
 def ragged_forward(cfg: LlamaConfig, params, tokens, slots, positions,
-                   block_tables, cache, ctx: ShardCtx | None = None):
+                   block_tables, cache):
     """Flat ragged step: ``[T]`` mixed tokens -> (``[T, V]`` logits, cache).
 
     Each token carries (slot, absolute position); ``block_tables``
@@ -314,8 +317,8 @@ def ragged_forward(cfg: LlamaConfig, params, tokens, slots, positions,
     of prefill chunks and decodes (reference ``inference/v2/engine_v2.py:30``
     ``put()`` + ``ragged_ops`` kernels).
     """
-    ctx = ctx or ShardCtx()
-    x = ctx.embed_lookup(params["embed"], tokens).astype(cache["k"].dtype)
+    # plain gather (see decode_forward's note: replication is a training fix)
+    x = params["embed"][tokens].astype(cache["k"].dtype)
 
     def body(x, lp_kv):
         lp, kc, vc = lp_kv
@@ -420,6 +423,6 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         init_cache_fn=partial(init_cache, cfg),
         decode_fn=partial(decode_forward, cfg, ctx=ctx),
         init_paged_cache_fn=partial(init_paged_cache, cfg),
-        ragged_forward_fn=partial(ragged_forward, cfg, ctx=ctx),
+        ragged_forward_fn=partial(ragged_forward, cfg),
         pipeline_parts=pipeline_parts(cfg, ctx=ctx, attn_impl=attn_impl),
     )

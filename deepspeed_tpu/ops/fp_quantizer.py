@@ -19,6 +19,8 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from deepspeed_tpu.ops.quantizer import _from_blocks, _to_blocks
+
 # (exponent bits, mantissa bits) per format; fp8 formats also have a native dtype
 _FORMATS = {
     "fp8_e4m3": (4, 3),
@@ -54,19 +56,11 @@ def _grid_max(fmt: str) -> float:
 
 
 class FPQuantizedTensor(NamedTuple):
-    values: jnp.ndarray   # native fp8 dtype, or fp32 grid values for fp6/fp4
+    values: jnp.ndarray   # native fp8 dtype, or int8 s/e/m bit codes for fp6/fp4
     scales: jnp.ndarray   # f32 per-block scales
     shape: tuple
     fmt: str
     block: int
-
-
-def _to_blocks(x: jnp.ndarray, block: int):
-    flat = x.reshape(-1)
-    pad = (-flat.size) % block
-    if pad:
-        flat = jnp.pad(flat, (0, pad))
-    return flat.reshape(-1, block)
 
 
 def _round_to_grid(x: jnp.ndarray, exp_bits: int, man_bits: int, limit: float) -> jnp.ndarray:
@@ -129,7 +123,7 @@ def fp_quantize(x: jnp.ndarray, fmt: str = "fp8_e4m3",
     if fmt not in _FORMATS:
         raise ValueError(f"unknown format {fmt!r} (choose from {sorted(_FORMATS)})")
     exp_bits, man_bits = _FORMATS[fmt]
-    blocks = _to_blocks(x.astype(jnp.float32), block)
+    blocks, _ = _to_blocks(x.astype(jnp.float32), block)
     absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
     limit = _grid_max(fmt)
     scale = jnp.maximum(absmax, 1e-30) / limit
@@ -150,11 +144,7 @@ def fp_dequantize(qt: FPQuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
         exp_bits, man_bits = _FORMATS[qt.fmt]
         grid = _decode_codes(qt.values, exp_bits, man_bits)
     vals = grid * qt.scales[:, None]
-    flat = vals.reshape(-1)
-    size = 1
-    for s in qt.shape:
-        size *= s
-    return flat[:size].reshape(qt.shape).astype(dtype)
+    return _from_blocks(vals, qt.shape, dtype)
 
 
 def fp_quantize_dequantize(x: jnp.ndarray, fmt: str = "fp8_e4m3",

@@ -31,6 +31,15 @@ def _to_blocks(x: jnp.ndarray, block: int):
     return flat.reshape(-1, block), pad
 
 
+def _from_blocks(vals: jnp.ndarray, shape: tuple, dtype) -> jnp.ndarray:
+    """Inverse of :func:`_to_blocks`: drop padding, restore shape."""
+    flat = vals.reshape(-1)
+    size = 1
+    for s in shape:
+        size *= s
+    return flat[:size].reshape(shape).astype(dtype)
+
+
 def quantize(x: jnp.ndarray, bits: int = 8, block: int = 256) -> QuantizedTensor:
     """Symmetric per-block quantization (reference ``quantize.cu`` semantics)."""
     assert bits in (8, 4), bits
@@ -55,11 +64,7 @@ def dequantize(qt: QuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
         hi = q >> 4                                   # arithmetic shift keeps sign
         q = jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
     vals = q.astype(jnp.float32) * qt.scales[:, None]
-    flat = vals.reshape(-1)
-    size = 1
-    for s in qt.shape:
-        size *= s
-    return flat[:size].reshape(qt.shape).astype(dtype)
+    return _from_blocks(vals, qt.shape, dtype)
 
 
 def quantize_dequantize(x: jnp.ndarray, bits: int = 8, block: int = 256) -> jnp.ndarray:

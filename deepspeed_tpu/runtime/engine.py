@@ -255,6 +255,15 @@ class Engine:
 
         self.monitor = MonitorMaster(config.monitor)
 
+        # jax.profiler capture window + debug-nans trap (reference nvtx
+        # instrumentation / sanity-check config, SURVEY §5.1-5.2)
+        from deepspeed_tpu.utils.tracing import StepTracer
+
+        self.step_tracer = StepTracer(config.tracing)
+        if config.debug.nans:
+            jax.config.update("jax_debug_nans", True)
+            log_dist("debug.nans: trapping the first NaN-producing op", ranks=[0])
+
         # ZeRO++-style quantized gradient reduction (qgZ): grads stay rank-
         # local through the GAS scan inside a shard_map over the data axis and
         # reduce ONCE at the boundary through int8 all-to-all/all-gather with
@@ -713,6 +722,9 @@ class Engine:
                 data_iter = self.training_dataloader
             micro = [next(data_iter) for _ in range(self.gas)]
             batch = {k: np.concatenate([np.asarray(m[k]) for m in micro]) for k in micro[0]}
+        if self.config.debug.sanity_checks:
+            self._sanity_check_batch(batch)
+        self.step_tracer.before_step(self.global_steps)
         if self._offload_mode == "nvme":
             return self._train_batch_nvme(batch)
         if self._train_batch_jit is None:
@@ -813,6 +825,30 @@ class Engine:
         self._acc_count = 0
         self._after_step(metrics)
 
+    def _sanity_check_batch(self, batch: dict) -> None:
+        """Host-side semantic checks (reference ``enable_sanity_checks`` /
+        config cross-validation): catches shape/dtype mistakes before they
+        become opaque XLA errors."""
+        if not isinstance(batch, dict) or not batch:
+            raise ValueError("sanity: batch must be a non-empty dict of arrays")
+        lead = None
+        for k, v in batch.items():
+            a = np.asarray(v)
+            if a.ndim < 1:
+                raise ValueError(f"sanity: batch[{k!r}] must have a batch dim")
+            if lead is None:
+                lead = a.shape[0]
+            elif a.shape[0] != lead:
+                raise ValueError(
+                    f"sanity: batch[{k!r}] leading dim {a.shape[0]} != {lead}")
+        if self.config.train_batch_size and lead != self.config.train_batch_size:
+            raise ValueError(
+                f"sanity: batch size {lead} != configured train_batch_size "
+                f"{self.config.train_batch_size}")
+        ids = batch.get("input_ids")
+        if ids is not None and not np.issubdtype(np.asarray(ids).dtype, np.integer):
+            raise ValueError("sanity: input_ids must be an integer array")
+
     def _after_step(self, metrics):
         self.global_steps += 1
         self.global_samples += int(self.config.train_batch_size or 0)
@@ -857,6 +893,7 @@ class Engine:
                 f"grad_norm={float(self._last_metrics['grad_norm']):.3f} {skip_str}",
                 ranks=[0],
             )
+        self.step_tracer.after_step(self.global_steps - 1)
 
     # ------------------------------------------------------------------ checkpoint
     def save_checkpoint(self, save_dir: str, tag: str | None = None,

@@ -1,0 +1,99 @@
+"""Profiler tracing hooks (the reference's nvtx instrumentation, TPU-native).
+
+Role parity with ``deepspeed/utils/nvtx.py:25 instrument_w_nvtx`` (decorator
+pushing an nvtx range around every hot function) and the accelerator
+``range_push/pop`` API — expressed with ``jax.profiler``: host-side spans use
+``TraceAnnotation``, traced-code regions use ``jax.named_scope`` (which names
+the HLO ops so device traces attribute time to framework phases), and whole
+training windows are captured with ``start_trace``/``stop_trace`` driven by
+the engine's ``tracing`` config (viewable in TensorBoard/XProf/Perfetto).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+# traced-code scope: names HLO ops (device-side attribution)
+named_scope = jax.named_scope
+
+
+def instrument(name: str | None = None):
+    """Decorator: host-side profiler span around the call
+    (``instrument_w_nvtx`` analog)."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def range_push(name: str):
+    """Imperative span begin (reference ``accelerator.range_push``). Returns
+    the annotation object; pass it to :func:`range_pop`."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    return ann
+
+
+def range_pop(ann) -> None:
+    ann.__exit__(None, None, None)
+
+
+class StepTracer:
+    """Drives a bounded ``jax.profiler`` capture window over training steps
+    (config ``tracing``: start at ``start_step``, run ``num_steps``, write to
+    ``trace_dir``), annotating each step for the trace viewer's step view."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._active = False
+        self._done = False
+        self._started_at = 0
+        self._step_ann = None
+        if cfg.enabled:
+            # the capture is only written at stop_trace; guarantee it lands
+            # even if the run ends inside the window
+            import atexit
+
+            atexit.register(self.close)
+
+    def before_step(self, step: int) -> None:
+        if not self.cfg.enabled or self._done:
+            return
+        # >= so a resumed run (global step already past start_step) still
+        # captures its first window
+        if not self._active and step >= self.cfg.start_step:
+            jax.profiler.start_trace(self.cfg.trace_dir)
+            self._active = True
+            self._started_at = step
+        if self._active:
+            self._step_ann = jax.profiler.StepTraceAnnotation(
+                "train_step", step_num=step)
+            self._step_ann.__enter__()
+
+    def after_step(self, step: int) -> None:
+        if self._step_ann is not None:
+            self._step_ann.__exit__(None, None, None)
+            self._step_ann = None
+        if self._active and step >= self._started_at + self.cfg.num_steps - 1:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def close(self) -> None:
+        if self._step_ann is not None:
+            self._step_ann.__exit__(None, None, None)
+            self._step_ann = None
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

@@ -41,6 +41,14 @@ os.environ["DSTPU_ACCELERATOR"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Synchronous CPU dispatch: with async dispatch, multiple in-flight 8-device
+# collective programs time-slicing ONE core can wedge XLA's in-process
+# collective rendezvous (observed as 0%-CPU hangs deep into long sessions).
+# CPU-only knob; TPU async stepping is unaffected.
+try:
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+except Exception:
+    pass
 
 # persistent compilation cache: repeat runs of the suite skip XLA recompiles
 # (the dominant cost — every engine test jits a full train step)
