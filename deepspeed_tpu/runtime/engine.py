@@ -259,7 +259,9 @@ class Engine:
         # instrumentation / sanity-check config, SURVEY §5.1-5.2)
         from deepspeed_tpu.utils.tracing import StepTracer
 
-        self.step_tracer = StepTracer(config.tracing)
+        self.step_tracer = StepTracer(
+            config.tracing,
+            sync_fn=lambda: jax.block_until_ready(self._last_metrics))
         if config.debug.nans:
             jax.config.update("jax_debug_nans", True)
             log_dist("debug.nans: trapping the first NaN-producing op", ranks=[0])
@@ -782,6 +784,12 @@ class Engine:
                 "optimizer state or quantized gradient reduction; use "
                 "train_batch()"
             )
+        if self.config.debug.sanity_checks:
+            micro_total = (self.config.train_batch_size or 0) // self.gas or None
+            self._sanity_check_batch(batch, expected=micro_total)
+        if self._acc_grads is None:
+            # a fresh accumulation cycle = a new "step" for the tracer
+            self.step_tracer.before_step(self.global_steps)
         if self._accum_jit is None:
             self._accum_jit = self._build_accum_fn()
         if self._acc_grads is None:
@@ -825,10 +833,13 @@ class Engine:
         self._acc_count = 0
         self._after_step(metrics)
 
-    def _sanity_check_batch(self, batch: dict) -> None:
+    def _sanity_check_batch(self, batch: dict, expected: int | None = None) -> None:
         """Host-side semantic checks (reference ``enable_sanity_checks`` /
         config cross-validation): catches shape/dtype mistakes before they
-        become opaque XLA errors."""
+        become opaque XLA errors. ``expected`` is the required leading dim
+        (defaults to the full train batch)."""
+        if expected is None:
+            expected = self.config.train_batch_size
         if not isinstance(batch, dict) or not batch:
             raise ValueError("sanity: batch must be a non-empty dict of arrays")
         lead = None
@@ -841,10 +852,11 @@ class Engine:
             elif a.shape[0] != lead:
                 raise ValueError(
                     f"sanity: batch[{k!r}] leading dim {a.shape[0]} != {lead}")
-        if self.config.train_batch_size and lead != self.config.train_batch_size:
+        if expected and lead != expected:
             raise ValueError(
-                f"sanity: batch size {lead} != configured train_batch_size "
-                f"{self.config.train_batch_size}")
+                f"sanity: batch size {lead} != expected {expected} "
+                f"(configured train_batch_size "
+                f"{self.config.train_batch_size}, GAS {self.gas})")
         ids = batch.get("input_ids")
         if ids is not None and not np.issubdtype(np.asarray(ids).dtype, np.integer):
             raise ValueError("sanity: input_ids must be an integer array")

@@ -53,8 +53,12 @@ class StepTracer:
     (config ``tracing``: start at ``start_step``, run ``num_steps``, write to
     ``trace_dir``), annotating each step for the trace viewer's step view."""
 
-    def __init__(self, cfg):
+    def __init__(self, cfg, sync_fn=None):
         self.cfg = cfg
+        # called before stop_trace: block on in-flight device work so the
+        # capture contains the traced steps' device activity (the engine
+        # pipelines steps without per-step sync)
+        self.sync_fn = sync_fn
         self._active = False
         self._done = False
         self._started_at = 0
@@ -85,6 +89,8 @@ class StepTracer:
             self._step_ann.__exit__(None, None, None)
             self._step_ann = None
         if self._active and step >= self._started_at + self.cfg.num_steps - 1:
+            if self.sync_fn is not None:
+                self.sync_fn()
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
@@ -94,6 +100,8 @@ class StepTracer:
             self._step_ann.__exit__(None, None, None)
             self._step_ann = None
         if self._active:
+            if self.sync_fn is not None:
+                self.sync_fn()
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
