@@ -116,6 +116,12 @@ class ZeroConfig(ConfigBase):
     # ZeRO++ qgZ: int8-quantized gradient reduction with error feedback
     # (comm/quantized_collectives.py; requires a pure data-parallel mesh)
     quantized_gradients: bool = False
+    # MiCS / ZeRO++ hpZ: optimizer+gradient state shards over the FULL world
+    # (data x fsdp) while live stage-3 params shard over fsdp only, so param
+    # gathers ride the fast intra-group axis (reference runtime/zero/mics.py
+    # + partition_parameters.py:1806 secondary partition). Map the reference
+    # layout onto the mesh: fsdp = intra-group (ICI), data = across groups.
+    hierarchical_partitioning: bool = False
 
     def _validate(self, path: str = "") -> None:
         if self.stage not in (0, 1, 2, 3):
@@ -124,17 +130,33 @@ class ZeroConfig(ConfigBase):
     @classmethod
     def from_dict(cls, data, path: str = ""):
         data = dict(data or {})
+        # Reference hpZ knob -> hierarchical partitioning (the group size is
+        # implied by the mesh's fsdp axis here, not a free integer).
+        if "zero_hpz_partition_size" in data:
+            from deepspeed_tpu.utils.logging import logger
+
+            hpz = data.pop("zero_hpz_partition_size")
+            try:
+                hpz_on = int(hpz) > 0
+            except (TypeError, ValueError):
+                hpz_on = bool(hpz)  # "auto" etc.: treat truthy as enabled
+            if hpz_on and "hierarchical_partitioning" not in data:
+                logger.warning(
+                    f"Config field '{path}zero_hpz_partition_size' maps to "
+                    "'hierarchical_partitioning: true' in this build (the "
+                    "secondary-partition group is the mesh's fsdp axis)."
+                )
+                data["hierarchical_partitioning"] = True
         # Reference knobs this build doesn't implement: accept + warn rather
         # than hard-failing ported DeepSpeed configs.
-        for unsupported in ("quantized_weights", "zero_hpz_partition_size"):
-            if unsupported in data:
-                from deepspeed_tpu.utils.logging import logger
+        if "quantized_weights" in data:
+            from deepspeed_tpu.utils.logging import logger
 
-                logger.warning(
-                    f"Config field '{path}{unsupported}' is not supported in "
-                    "this build and is ignored."
-                )
-                data.pop(unsupported)
+            logger.warning(
+                f"Config field '{path}quantized_weights' is not supported in "
+                "this build and is ignored."
+            )
+            data.pop("quantized_weights")
         # Legacy `cpu_offload` was a bool; translate to an offload tier, not a rename.
         if "cpu_offload" in data:
             from deepspeed_tpu.utils.logging import logger

@@ -62,6 +62,7 @@ def _spec_for_param(
     dim_units: dict,
     persistence_threshold: int,
     pp_fsdp: bool = False,
+    hierarchical: bool = False,
 ) -> PartitionSpec:
     assign: list = [None] * len(shape)
     size = 1
@@ -109,16 +110,34 @@ def _spec_for_param(
 
     fsdp = topo.size(AXIS_FSDP)
     if shard_params_fsdp and fsdp > 1 and size > persistence_threshold:
+        # hierarchical (MiCS/hpZ): optimizer/grad state shards over the FULL
+        # world (data x fsdp) while the live-param layout keeps fsdp only, so
+        # parameter gathers ride the fast intra-group axis
+        entry = AXIS_FSDP
+        div = fsdp
+        if hierarchical and topo.size(AXIS_DATA) > 1:
+            # fsdp-major order: each live fsdp shard is SUBDIVIDED along the
+            # data axis, so the master->live gather is a pure data-axis
+            # collective per fsdp coordinate (the hpZ fast-axis property)
+            entry = (AXIS_FSDP, AXIS_DATA)
+            div = fsdp * topo.size(AXIS_DATA)
         candidates = [
             i
             for i in range(len(shape))
             if assign[i] is None
             and (axes[i] not in _FSDP_EXCLUDED)
-            and shape[i] % fsdp == 0
+            and shape[i] % div == 0
         ]
         if candidates:
             best = max(candidates, key=lambda i: shape[i])
-            assign[best] = AXIS_FSDP
+            assign[best] = entry
+        elif hierarchical:
+            # fall back to fsdp-only sharding if the world size doesn't divide
+            fall = [i for i in range(len(shape))
+                    if assign[i] is None and axes[i] not in _FSDP_EXCLUDED
+                    and shape[i] % fsdp == 0]
+            if fall:
+                assign[max(fall, key=lambda i: shape[i])] = AXIS_FSDP
     return PartitionSpec(*assign)
 
 
@@ -169,6 +188,7 @@ def plan_sharding(
     dim_units: dict | None = None,
     persistence_threshold: int = 0,
     pp_fsdp: bool = False,
+    hierarchical: bool = False,
 ) -> ShardingPlan:
     """Build the full sharding plan for a model's parameter pytree.
 
@@ -187,18 +207,43 @@ def plan_sharding(
         )
     treedef = jax.tree_util.tree_structure(abstract_params)
 
-    def build(shard_fsdp: bool):
+    def build(shard_fsdp: bool, hier: bool = False):
         specs = [
             _spec_for_param(
                 ax, tuple(p.shape), topo, shard_fsdp, use_tp, dim_units,
-                persistence_threshold, pp_fsdp=pp_fsdp,
+                persistence_threshold, pp_fsdp=pp_fsdp, hierarchical=hier,
             )
             for ax, p in zip(axes_leaves, param_leaves)
         ]
         return jax.tree_util.tree_unflatten(treedef, specs)
 
-    shard_specs = build(shard_fsdp=True)
-    param_specs = shard_specs if zero_stage >= 3 else build(shard_fsdp=False)
+    shard_specs = build(shard_fsdp=True, hier=hierarchical)
+    if zero_stage >= 3:
+        if hierarchical:
+            # hierarchical keeps LIVE params on the fast (fsdp) axis only —
+            # the hpZ secondary partition (partition_parameters.py:1806).
+            # Derived from shard_specs by DROPPING the data axis so live and
+            # master layouts shard the SAME dim (live is a refinement).
+            def _drop_data(spec):
+                entries = []
+                for e in spec:
+                    if isinstance(e, tuple) and AXIS_DATA in e:
+                        rest = tuple(a for a in e if a != AXIS_DATA)
+                        entries.append(rest[0] if len(rest) == 1
+                                       else (rest if rest else None))
+                    elif e == AXIS_DATA:
+                        entries.append(None)
+                    else:
+                        entries.append(e)
+                return PartitionSpec(*entries)
+
+            param_specs = jax.tree_util.tree_map(
+                _drop_data, shard_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
+        else:
+            param_specs = shard_specs
+    else:
+        param_specs = build(shard_fsdp=False)
     grad_specs = shard_specs if zero_stage >= 2 else param_specs
 
     from deepspeed_tpu.comm.topology import batch_spec_entry

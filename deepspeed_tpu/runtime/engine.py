@@ -116,6 +116,7 @@ class Engine:
             dim_units=self.model_spec.logical_dim_units,
             persistence_threshold=zero.persistence_threshold,
             pp_fsdp=config.pipeline.schedule == "1f1b",
+            hierarchical=zero.hierarchical_partitioning,
         )
 
         # ---- params (fp32 master), placed per plan (reference zero.Init analog)

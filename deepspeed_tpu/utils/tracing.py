@@ -93,7 +93,20 @@ class StepTracer:
                 self.sync_fn()
             jax.profiler.stop_trace()
             self._active = False
-            self._done = True
+            self._finish()
+
+    def _finish(self) -> None:
+        """Capture complete: drop the engine-capturing sync closure and the
+        atexit registration so the tracer doesn't pin the engine (and its
+        device arrays) for process lifetime."""
+        self._done = True
+        self.sync_fn = None
+        import atexit
+
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
 
     def close(self) -> None:
         if self._step_ann is not None:
@@ -104,4 +117,4 @@ class StepTracer:
                 self.sync_fn()
             jax.profiler.stop_trace()
             self._active = False
-            self._done = True
+        self._finish()
