@@ -299,6 +299,52 @@ class Engine:
             log_dist("gradient reduction: int8 quantized (qgZ) over the data "
                      f"axis (n={n}) with error feedback", ranks=[0])
 
+        # ZenFlow split update over the offloaded tier (runtime/zenflow.py;
+        # reference runtime/zenflow/zenflow_stage_1_and_2.py:47)
+        zf_cfg = zero.zenflow
+        self._zenflow = bool(zf_cfg.enabled)
+        if self._zenflow:
+            from deepspeed_tpu.runtime import zenflow as zenflow_mod
+
+            if self._offload_mode != "cpu":
+                raise ValueError(
+                    "zenflow requires zero_optimization.offload_optimizer."
+                    "device='cpu' (reference _configure_zenflow: 'Zenflow "
+                    "must be used with cpu offload')")
+            if self.zero_stage not in (1, 2):
+                raise ValueError(
+                    "zenflow supports ZeRO stages 1/2 (reference "
+                    "ZenFlowZeroOptimizer extends the stage-1/2 optimizer)")
+            if self._qgrad:
+                raise ValueError(
+                    "zenflow and quantized_gradients are mutually exclusive")
+            ot = config.optimizer.type.lower()
+            if ot not in ("adam", "adamw"):
+                raise ValueError(
+                    f"zenflow requires an Adam-family optimizer, got {ot!r} "
+                    "(reference uses ZenFlowSelectiveAdamW for the hot set)")
+            op = dict(config.optimizer.params)
+            betas = op.get("betas", (0.9, 0.999))
+            self._zf = zenflow_mod
+            self._zf_hyper = dict(
+                block=zf_cfg.block, b1=float(betas[0]), b2=float(betas[1]),
+                eps=float(op.get("eps", 1e-8)),
+                weight_decay=float(op.get("weight_decay", 0.0)),
+            )
+            self._zf_hot = zenflow_mod.init_hot_state(
+                param_leaves, zf_cfg.topk_ratio, zf_cfg.block)
+            self._zf_acc = None          # cold-gradient accumulator (lazy)
+            self._zf_n_acc = 0           # steps since the last cold update
+            self._zf_n_dev = jnp.int32(0)  # finite (accumulated) steps, on device
+            self._zf_selected = False    # becomes True at the first selection
+            self._zf_hot_jit = None
+            self._zf_cold_jit = None
+            self._zf_select_jit = None
+            log_dist(
+                f"zenflow: hot top-{zf_cfg.topk_ratio:.0%} blocks on device "
+                f"every step, cold update every {zf_cfg.update_interval} "
+                f"steps, re-select every {zf_cfg.select_interval}", ranks=[0])
+
         if (self._offload_mode == "nvme"
                 and config.pipeline.schedule == "1f1b"
                 and topo.size("pipeline") > 1):
@@ -375,25 +421,9 @@ class Engine:
         lr = self.lr_schedule(step)
 
         if self._offload_mode == "cpu":
-            from deepspeed_tpu.runtime import offload as offload_mod
-
-            p_leaves = jax.tree_util.tree_leaves(params)
-            g_leaves = jax.tree_util.tree_leaves(grads)
-            new_p_leaves = list(p_leaves)
-            new_opt = []
-            for g, idx in enumerate(self._groups):
-                pg = tuple(p_leaves[i] for i in idx)
-                gg = tuple(g_leaves[i] for i in idx)
-                dev_sh, store_sh = self._group_shardings[g]
-                state = offload_mod.stream_in(opt_state[g], dev_sh)
-                updates, new_state = self.optimizer.update(gg, state, pg)
-                newp = optax.apply_updates(
-                    pg, jax.tree_util.tree_map(lambda u: u * lr, updates))
-                newp = _tree_select(finite, newp, pg)
-                new_state = _tree_select(finite, new_state, state)
-                new_opt.append(offload_mod.stream_out(new_state, store_sh))
-                for j, i in enumerate(idx):
-                    new_p_leaves[i] = newp[j]
+            new_p_leaves, new_opt = self._offload_group_walk(
+                jax.tree_util.tree_leaves(params), opt_state,
+                jax.tree_util.tree_leaves(grads), lr, finite)
             new_params = jax.tree_util.tree_unflatten(
                 self._param_treedef, new_p_leaves)
         else:
@@ -410,6 +440,30 @@ class Engine:
             "skipped": jnp.logical_not(finite),
         }
         return new_params, new_opt, new_scale, metrics
+
+    def _offload_group_walk(self, p_leaves, opt_groups, g_leaves, lr, finite):
+        """Windowed sub-group update over host-pinned optimizer state
+        (reference ``stage3.py:2360 _prepare_sub_group``): stream one group's
+        state HBM-ward, update, stream back — shared by the dense offload tail
+        and the zenflow cold update. All writes guarded by ``finite``."""
+        from deepspeed_tpu.runtime import offload as offload_mod
+
+        new_p = list(p_leaves)
+        new_opt = []
+        for g, idx in enumerate(self._groups):
+            pg = tuple(p_leaves[i] for i in idx)
+            gg = tuple(g_leaves[i] for i in idx)
+            dev_sh, store_sh = self._group_shardings[g]
+            state = offload_mod.stream_in(opt_groups[g], dev_sh)
+            updates, new_state = self.optimizer.update(gg, state, pg)
+            newp = optax.apply_updates(
+                pg, jax.tree_util.tree_map(lambda u: u * lr, updates))
+            newp = _tree_select(finite, newp, pg)
+            new_state = _tree_select(finite, new_state, state)
+            new_opt.append(offload_mod.stream_out(new_state, store_sh))
+            for j, i in enumerate(idx):
+                new_p[i] = newp[j]
+        return new_p, new_opt
 
     def _gas_grads(self, params, scale_state, step, base_rng, batch):
         """The traced GAS fwd/bwd body shared by the fused step and the
@@ -662,6 +716,149 @@ class Engine:
         self.micro_steps += self.gas
         return metrics["loss"]
 
+    # ------------------------------------------------------------------ zenflow
+    def _build_zf_hot_fn(self):
+        """Jitted per-step ZenFlow tail: unscale+clip, selective hot update,
+        cold accumulate, loss-scale bookkeeping (reference
+        ``ZenFlowSelectiveAdamW.step`` + the stage-1/2 step prologue)."""
+        cfg = self.config
+        hyper = self._zf_hyper
+
+        def hot_fn(p_leaves, hot, acc_leaves, g_leaves, scale_state, step, n_acc):
+            denom = scale_state.scale * jnp.float32(self.gas)
+            grads = [g / denom for g in g_leaves]
+            finite = precision.grads_finite(grads)
+            gnorm = _global_norm(grads)
+            if cfg.gradient_clipping > 0:
+                coef = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
+                grads = [g * coef for g in grads]
+            lr = self.lr_schedule(step)
+            new_p, new_hot, new_acc = self._zf.hot_step(
+                p_leaves, hot, grads, acc_leaves, lr, finite, **hyper)
+            new_scale = precision.update_loss_scale(scale_state, finite, cfg.fp16)
+            metrics = {
+                "grad_norm": gnorm,
+                "lr": lr,
+                "loss_scale": scale_state.scale,
+                "skipped": jnp.logical_not(finite),
+            }
+            # count only the steps that actually accumulated (overflow steps
+            # add nothing — dividing by the raw window length would dilute
+            # the cold mean)
+            new_n = n_acc + jnp.where(finite, 1, 0).astype(jnp.int32)
+            return new_p, new_hot, new_acc, new_scale, metrics, new_n
+
+        return jax.jit(hot_fn, donate_argnums=(0, 1, 2, 3))
+
+    def _build_zf_cold_fn(self):
+        """Jitted deferred cold update: the standard windowed sub-group walk
+        over host-pinned optimizer state, applied to the accumulated cold
+        gradients; hot coordinates are restored afterwards (the selective
+        optimizer owns them, reference zenflow split). Dispatched async at the
+        interval boundary — XLA overlaps its host<->HBM streams with the next
+        steps' compute (the reference's overlap_step worker process)."""
+        block = self.config.zero_optimization.zenflow.block
+
+        def cold_fn(p_leaves, opt_groups, acc_leaves, idx_leaves, n_acc, step):
+            lr = self.lr_schedule(step)
+            # n_acc counts only finite (accumulated) steps; a fully-overflowed
+            # window must be a no-op, not an adamw step on zero gradients
+            any_acc = n_acc > 0
+            n = jnp.maximum(n_acc, 1).astype(jnp.float32)
+            g_leaves = [a / n for a in acc_leaves]
+            new_p, new_opt = self._offload_group_walk(
+                p_leaves, opt_groups, g_leaves, lr, any_acc)
+            new_p = [
+                self._zf.restore_hot(old, new, hidx, block)
+                for old, new, hidx in zip(p_leaves, new_p, idx_leaves)
+            ]
+            new_acc = [jnp.zeros_like(a) for a in acc_leaves]
+            return new_p, new_opt, new_acc
+
+        return jax.jit(cold_fn, donate_argnums=(0, 1, 2))
+
+    def _train_batch_zenflow(self, batch: dict):
+        """Full ZenFlow step (reference ``zenflow_stage_1_and_2.py`` step
+        cadence): dense windowed updates during warm-up; then every step runs
+        the tiny hot update while cold gradients accumulate, with one deferred
+        windowed update per ``update_interval`` steps and importance
+        re-selection per ``select_interval``.
+
+        Note: the selective state (hot moments/indices and the cold
+        accumulator) is step-transient and not checkpointed; after a resume
+        the engine runs dense until the next selection boundary."""
+        zf = self.config.zero_optimization.zenflow
+        if self._grads_jit is None:
+            self._grads_jit = self._build_grads_fn()
+        dev_batch = self._put_gas_batch(batch)
+        self.tput_timer.start()
+        loss, grad_sum = self._grads_jit(
+            self.params, self.scale_state, jnp.int32(self.global_steps),
+            self._train_rng, dev_batch,
+        )
+        g_leaves, _ = jax.tree_util.tree_flatten(grad_sum)
+        p_leaves, tdef = jax.tree_util.tree_flatten(self.params)
+        step = self.global_steps
+        warmup = zf.full_warm_up_rounds
+        due = step >= warmup - 1 and (
+            not self._zf_selected
+            or (step - (warmup - 1)) % zf.select_interval == 0)
+        if due and bool(precision.grads_finite(g_leaves)):
+            # (re-)select from this step's gradients — |.| ordering is
+            # loss-scale invariant; overflow steps keep the old selection
+            if self._zf_select_jit is None:
+                self._zf_select_jit = jax.jit(
+                    lambda gl: self._zf.select(gl, zf.topk_ratio, zf.block))
+            new_idx = self._zf_select_jit(g_leaves)
+            self._zf_hot = self._zf.reset_moments(self._zf_hot, new_idx)
+            self._zf_selected = True
+
+        if step < warmup or not self._zf_selected:
+            # dense windowed update (reference full_warm_up_rounds)
+            if self._apply_jit is None:
+                self._apply_jit = self._build_apply_fn()
+            self.params, self.opt_state, self.scale_state, metrics = self._apply_jit(
+                self.params, self.opt_state, self.scale_state, grad_sum,
+                jnp.float32(self.gas), jnp.int32(step),
+            )
+        else:
+            if self._zf_acc is None:
+                grad_ns = jax.tree_util.tree_leaves(self._grad_ns())
+                self._zf_acc = [
+                    jax.device_put(jnp.zeros(p.shape, jnp.float32), s)
+                    for p, s in zip(p_leaves, grad_ns)
+                ]
+            if self._zf_hot_jit is None:
+                self._zf_hot_jit = self._build_zf_hot_fn()
+            (new_p_leaves, self._zf_hot, self._zf_acc, self.scale_state,
+             metrics, self._zf_n_dev) = self._zf_hot_jit(
+                p_leaves, self._zf_hot, self._zf_acc, g_leaves,
+                self.scale_state, jnp.int32(step), self._zf_n_dev,
+            )
+            self.params = jax.tree_util.tree_unflatten(tdef, new_p_leaves)
+            self._zf_n_acc += 1
+            if self._zf_n_acc >= zf.update_interval:
+                if self._zf_cold_jit is None:
+                    self._zf_cold_jit = self._build_zf_cold_fn()
+                p2, _ = jax.tree_util.tree_flatten(self.params)
+                idx_leaves = [h["idx"] for h in self._zf_hot["leaves"]]
+                new_p, self.opt_state, self._zf_acc = self._zf_cold_jit(
+                    p2, self.opt_state, self._zf_acc, idx_leaves,
+                    self._zf_n_dev, jnp.int32(step),
+                )
+                self.params = jax.tree_util.tree_unflatten(tdef, new_p)
+                self._zf_n_acc = 0
+                self._zf_n_dev = jnp.int32(0)
+        metrics["loss"] = loss
+        # same bounded async-dispatch window as the fused path
+        self._inflight.append(metrics["loss"])
+        if len(self._inflight) > self._max_inflight:
+            jax.block_until_ready(self._inflight.pop(0))
+        self.tput_timer.stop(global_step=True)
+        self._after_step(metrics)
+        self.micro_steps += self.gas
+        return metrics["loss"]
+
     def _build_accum_fn(self):
         def accum_fn(params, acc, scale_state, rng, mb):
             loss, grads = self._microbatch_grads(params, mb, rng, scale_state.scale)
@@ -730,6 +927,8 @@ class Engine:
         self.step_tracer.before_step(self.global_steps)
         if self._offload_mode == "nvme":
             return self._train_batch_nvme(batch)
+        if self._zenflow:
+            return self._train_batch_zenflow(batch)
         if self._train_batch_jit is None:
             self._train_batch_jit = self._build_train_batch_fn()
         dev_batch = self._put_gas_batch(batch)
@@ -779,11 +978,11 @@ class Engine:
         Returns the (unscaled) loss. Gradients live in a persistent buffer
         sharded per the ZeRO plan until ``step()`` consumes them.
         """
-        if self._offload_mode == "nvme" or self._qgrad:
+        if self._offload_mode == "nvme" or self._qgrad or self._zenflow:
             raise NotImplementedError(
                 "the fwd/bwd/step parity path does not support NVMe-offloaded "
-                "optimizer state or quantized gradient reduction; use "
-                "train_batch()"
+                "optimizer state, quantized gradient reduction, or zenflow; "
+                "use train_batch()"
             )
         if self.config.debug.sanity_checks:
             micro_total = (self.config.train_batch_size or 0) // self.gas or None

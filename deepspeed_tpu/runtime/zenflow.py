@@ -1,0 +1,146 @@
+"""ZenFlow: importance-aware split update for the offloaded optimizer tier.
+
+Role parity with the reference ZenFlow
+(``runtime/zenflow/zenflow_stage_1_and_2.py:47 ZenFlowZeroOptimizer``,
+``ops/adam ZenFlowSelectiveAdamW``, ``runtime/zenflow/zenflow_config.py``):
+every step, the top-k *important* gradient coordinates are applied on the
+accelerator immediately by a selective AdamW whose moments live in HBM; the
+cold remainder accumulates and is applied in ONE deferred windowed update every
+``update_interval`` steps. Selection refreshes from gradient magnitude every
+``select_interval`` steps; the first ``full_warm_up_rounds`` steps run dense
+updates.
+
+TPU-native mechanism (not a port): the reference exists to hide a synchronous
+host AdamW behind GPU compute with a separate CPU optimizer process
+(``zenflow_utils.py start_optimizer_process``). On TPU the offloaded update
+already runs on-device over host-streamed shards (``runtime/offload.py``), so
+the stall it fights does not arise; what ZenFlow buys here is *amortization*:
+full optimizer state streams host<->HBM once per ``update_interval`` steps
+instead of every step (~interval x less offload traffic), while the per-step
+hot update touches only the k selected blocks, whose moments are tiny and
+HBM-resident. The reference's "overlap_step" CPU worker becomes JAX async
+dispatch — the deferred cold program is dispatched at the boundary and XLA
+overlaps its host<->HBM streams with the next steps' compute.
+
+Selection is blockwise — lane-aligned ``[k, block]`` gathers instead of the
+reference's per-column index lists — the VPU-friendly analog of its per-column
+importance score (column norm of the gradient).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _nblocks(size: int, block: int) -> int:
+    return max(1, -(-size // block))
+
+
+def hot_k(size: int, ratio: float, block: int) -> int:
+    """Number of hot blocks for a leaf: ceil(ratio * n_blocks), >= 1."""
+    nb = _nblocks(size, block)
+    return max(1, min(nb, int(round(ratio * nb))))
+
+
+def init_hot_state(abstract_leaves, ratio: float, block: int) -> dict:
+    """Device-resident selective-optimizer state (reference
+    ``ZenFlowSelectiveAdamW`` per-param state): per leaf the selected block
+    ids and their Adam moments, plus one shared bias-correction counter that
+    resets on re-selection."""
+    per_leaf = []
+    for leaf in abstract_leaves:
+        k = hot_k(int(leaf.size), ratio, block)
+        per_leaf.append({
+            "idx": jnp.zeros((k,), jnp.int32),
+            "m": jnp.zeros((k, block), jnp.float32),
+            "v": jnp.zeros((k, block), jnp.float32),
+        })
+    return {"leaves": per_leaf, "t": jnp.zeros((), jnp.int32)}
+
+
+def _to_blocks(x, block: int):
+    flat = x.reshape(-1).astype(jnp.float32)
+    nb = _nblocks(flat.shape[0], block)
+    pad = nb * block - flat.shape[0]
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(nb, block)
+
+
+def select(grad_leaves, ratio: float, block: int) -> list:
+    """Blockwise importance selection (reference
+    ``zenflow_stage_1_and_2.py`` column-norm selection): per leaf, the top-k
+    blocks by summed |grad|. Returns the per-leaf hot block indices."""
+    out = []
+    for g in grad_leaves:
+        gb = _to_blocks(g, block)
+        scores = jnp.sum(jnp.abs(gb), axis=1)
+        k = hot_k(int(g.size), ratio, block)
+        _, idx = jax.lax.top_k(scores, k)
+        out.append(idx.astype(jnp.int32))
+    return out
+
+
+def hot_step(param_leaves, hot, grad_leaves, acc_leaves, lr, finite, *,
+             block: int, b1: float, b2: float, eps: float, weight_decay: float):
+    """One selective step (reference ``ZenFlowSelectiveAdamW.step``):
+    AdamW on the hot blocks only, cold remainder added to the accumulator.
+
+    ``grad_leaves`` must already be unscaled/clipped mean gradients. All
+    writes are guarded by ``finite`` so an overflow step changes nothing
+    (matching the dense paths' skip semantics).
+    """
+    t = hot["t"] + jnp.where(finite, 1, 0)
+    bc1 = 1.0 - b1 ** t.astype(jnp.float32)
+    bc2 = 1.0 - b2 ** t.astype(jnp.float32)
+    new_params, new_leaves, new_acc = [], [], []
+    for p, h, g, acc in zip(param_leaves, hot["leaves"], grad_leaves, acc_leaves):
+        shape, n = p.shape, int(p.size)
+        gb = _to_blocks(g, block)
+        pb = _to_blocks(p, block)
+        idx = h["idx"]
+        gh = gb[idx]                                   # [k, block]
+        m = b1 * h["m"] + (1.0 - b1) * gh
+        v = b2 * h["v"] + (1.0 - b2) * jnp.square(gh)
+        ph = pb[idx]
+        upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + weight_decay * ph
+        ph_new = jnp.where(finite, ph - lr * upd, ph)
+        pb = pb.at[idx].set(ph_new)
+        new_p = pb.reshape(-1)[:n].reshape(shape).astype(p.dtype)
+        new_params.append(new_p)
+        new_leaves.append({
+            "idx": idx,
+            "m": jnp.where(finite, m, h["m"]),
+            "v": jnp.where(finite, v, h["v"]),
+        })
+        cold = gb.at[idx].set(0.0).reshape(-1)[:n].reshape(shape)
+        new_acc.append(acc + jnp.where(finite, cold, 0.0))
+    return new_params, {"leaves": new_leaves, "t": t}, new_acc
+
+
+def restore_hot(p_old, p_new, idx, block: int):
+    """Undo the cold update on the hot blocks: the selective optimizer owns
+    them (the reference's CPU step skips the important columns outright)."""
+    pb_old = _to_blocks(p_old, block)
+    pb_new = _to_blocks(p_new, block)
+    pb = pb_new.at[idx].set(pb_old[idx])
+    n = int(p_old.size)
+    return pb.reshape(-1)[:n].reshape(p_old.shape).astype(p_new.dtype)
+
+
+def reset_moments(hot: dict, new_idx: list) -> dict:
+    """Re-selection (reference select_interval boundary): newly selected
+    blocks start with fresh moments; the bias-correction counter restarts."""
+    leaves = [
+        {"idx": idx, "m": jnp.zeros_like(h["m"]), "v": jnp.zeros_like(h["v"])}
+        for h, idx in zip(hot["leaves"], new_idx)
+    ]
+    return {"leaves": leaves, "t": jnp.zeros((), jnp.int32)}
+
+
+def hot_state_elements(hot: dict) -> int:
+    """Device-resident selective-state footprint in elements (for the
+    memory-claim tests: must be ~2 * ratio * model size, not model size)."""
+    return sum(int(h["m"].size + h["v"].size + h["idx"].size)
+               for h in hot["leaves"])
