@@ -160,5 +160,21 @@ def topology_initialized() -> bool:
 
 
 def reset_topology() -> None:
+    """Tear down the process-global topology (test harness API).
+
+    Quiesces the devices first: with async dispatch, work from the previous
+    engine can still be in flight on some of the simulated devices, and
+    interleaving a new engine's collectives with it can deadlock the CPU
+    backend's rendezvous (observed as an idle-CPU futex stall mid-suite on
+    the 1-core CI box)."""
     global _topology
+    try:
+        import jax
+
+        jax.effects_barrier()
+        # block on every live committed array so all per-device streams drain
+        for d in jax.live_arrays():
+            d.block_until_ready()
+    except Exception:
+        pass
     _topology = None

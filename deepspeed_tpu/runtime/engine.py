@@ -168,21 +168,32 @@ class Engine:
             from deepspeed_tpu.parallel.partition import grouped_opt_state_shardings
 
             host_ok = offload_mod.supports_memory_kinds(topo.mesh)
+            # SuperOffload mixed residency (reference superoffload_stage3.py
+            # subgroup_to_device): the first hbm_resident_fraction of groups
+            # skip the host tier entirely — no stream round trip for the
+            # hottest share of the state
+            n_hbm = 0
+            if zero.offload_optimizer.super_offload:
+                n_hbm = int(round(
+                    zero.offload_optimizer.hbm_resident_fraction
+                    * len(self._groups)))
             shard_leaves = jax.tree_util.tree_leaves(self.plan.param_shardings)
             self._group_shardings = []  # (device_kind, storage_kind) per group
             self.opt_state = []
-            for idx in self._groups:
+            for g, idx in enumerate(self._groups):
                 g_leaves = tuple(param_leaves[i] for i in idx)
                 g_shards = [shard_leaves[i] for i in idx]
                 dev_sh = grouped_opt_state_shardings(
                     self.optimizer, g_leaves, g_shards, topo.mesh)
-                store_sh = offload_mod.offload_shardings(dev_sh) if host_ok else dev_sh
+                store_sh = (dev_sh if (g < n_hbm or not host_ok)
+                            else offload_mod.offload_shardings(dev_sh))
                 self._group_shardings.append((dev_sh, store_sh))
                 self.opt_state.append(
                     jax.jit(self.optimizer.init, out_shardings=store_sh)(g_leaves)
                 )
             log_dist(
                 f"optimizer state in {len(self._groups)} sub-groups "
+                + (f"({n_hbm} HBM-resident, superoffload) " if n_hbm else "")
                 + ("pinned in host DRAM" if host_ok else
                    "(no host tier on this backend; windowing only)"),
                 ranks=[0],
@@ -637,11 +648,17 @@ class Engine:
         specializes per group's shapes automatically). ``factor`` folds
         unscale+clip into one multiplier (coef / (scale * n_micro))."""
 
-        def apply_g(pg, state, gg, factor, lr):
+        def apply_g(pg, state, gg, factor, lr, finite):
             gg = jax.tree_util.tree_map(lambda x: x * factor, gg)
             updates, new_state = self.optimizer.update(gg, state, pg)
             newp = optax.apply_updates(
                 pg, jax.tree_util.tree_map(lambda u: u * lr, updates))
+            # the overflow guard rides along on device — under superoffload
+            # this replaces the reference's speculative-step CPU rollback
+            # (superoffload_stage3.py _handle_overflow_rollback): an
+            # overflowed step writes back the unchanged state
+            newp = _tree_select(finite, newp, pg)
+            new_state = _tree_select(finite, new_state, state)
             return newp, new_state
 
         return jax.jit(apply_g, donate_argnums=(1,))
@@ -666,14 +683,26 @@ class Engine:
         cfg = self.config
         denom = self.scale_state.scale * jnp.float32(self.gas)
         gnorm = _global_norm(grad_sum) / denom
-        finite = bool(precision.grads_finite(grad_sum))
+        speculative = cfg.zero_optimization.offload_optimizer.super_offload
+        if speculative:
+            # SuperOffload speculative step (reference
+            # superoffload_stage3.py:204 rollback design): dispatch every
+            # group's update WITHOUT waiting for the overflow verdict — the
+            # finite predicate stays a device scalar and gates the writes
+            # inside the jitted apply, so an overflowed step writes back
+            # unchanged state instead of rolling back a mutated one
+            finite_dev = precision.grads_finite(grad_sum)
+            run_walk = True
+        else:
+            finite_dev = jnp.asarray(bool(precision.grads_finite(grad_sum)))
+            run_walk = bool(finite_dev)
         coef = jnp.float32(1.0)
         if cfg.gradient_clipping > 0:
             coef = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
         factor = coef / denom
         lr = self.lr_schedule(jnp.int32(self.global_steps))
 
-        if finite:
+        if run_walk:
             p_leaves = jax.tree_util.tree_leaves(self.params)
             g_leaves = jax.tree_util.tree_leaves(grad_sum)
             new_p_leaves = list(p_leaves)
@@ -690,7 +719,7 @@ class Engine:
                 pg = tuple(p_leaves[i] for i in idx)
                 gg = tuple(g_leaves[i] for i in idx)
                 newp, new_state = self._group_apply_jit(
-                    pg, state, gg, factor, lr)
+                    pg, state, gg, factor, lr, finite_dev)
                 # windowed write pipeline: free group g-1's write buffers
                 # before snapshotting group g, so host RAM holds ~one group
                 self._swapper.wait_keys(prev_write_keys)
@@ -703,13 +732,13 @@ class Engine:
                 self._param_treedef, new_p_leaves)
             self._swapper.commit()
         self.scale_state = precision.update_loss_scale(
-            self.scale_state, jnp.asarray(finite), cfg.fp16)
+            self.scale_state, finite_dev, cfg.fp16)
         metrics = {
             "loss": loss,
             "grad_norm": gnorm,
             "lr": lr,
             "loss_scale": denom / self.gas,
-            "skipped": jnp.asarray(not finite),
+            "skipped": jnp.logical_not(finite_dev),
         }
         self.tput_timer.stop(global_step=True)
         self._after_step(metrics)
@@ -777,6 +806,32 @@ class Engine:
 
         return jax.jit(cold_fn, donate_argnums=(0, 1, 2))
 
+    def _zf_cold_boundary(self, tdef):
+        """Apply the deferred cold update and reset the window counters."""
+        if self._zf_cold_jit is None:
+            self._zf_cold_jit = self._build_zf_cold_fn()
+        p_leaves, _ = jax.tree_util.tree_flatten(self.params)
+        idx_leaves = [h["idx"] for h in self._zf_hot["leaves"]]
+        new_p, self.opt_state, self._zf_acc = self._zf_cold_jit(
+            p_leaves, self.opt_state, self._zf_acc, idx_leaves,
+            self._zf_n_dev, jnp.int32(self.global_steps),
+        )
+        self.params = jax.tree_util.tree_unflatten(tdef, new_p)
+        self._zf_n_acc = 0
+        self._zf_n_dev = jnp.int32(0)
+
+    def _zf_reset_transients(self):
+        """Drop selective state (hot moments/indices, cold accumulator) — on
+        checkpoint load the restored trajectory must not inherit them; the
+        engine runs dense until the next selection boundary."""
+        zf = self.config.zero_optimization.zenflow
+        p_leaves = jax.tree_util.tree_leaves(self.params)
+        self._zf_hot = self._zf.init_hot_state(p_leaves, zf.topk_ratio, zf.block)
+        self._zf_acc = None
+        self._zf_n_acc = 0
+        self._zf_n_dev = jnp.int32(0)
+        self._zf_selected = False
+
     def _train_batch_zenflow(self, batch: dict):
         """Full ZenFlow step (reference ``zenflow_stage_1_and_2.py`` step
         cadence): dense windowed updates during warm-up; then every step runs
@@ -804,6 +859,12 @@ class Engine:
             not self._zf_selected
             or (step - (warmup - 1)) % zf.select_interval == 0)
         if due and bool(precision.grads_finite(g_leaves)):
+            # flush the pending cold window under the OLD selection first —
+            # re-selecting with gradients still accumulated would apply them
+            # at blocks restore_hot is about to claim (signal silently lost)
+            if self._zf_selected and self._zf_n_acc > 0:
+                self._zf_cold_boundary(tdef)
+                p_leaves, _ = jax.tree_util.tree_flatten(self.params)
             # (re-)select from this step's gradients — |.| ordering is
             # loss-scale invariant; overflow steps keep the old selection
             if self._zf_select_jit is None:
@@ -838,17 +899,7 @@ class Engine:
             self.params = jax.tree_util.tree_unflatten(tdef, new_p_leaves)
             self._zf_n_acc += 1
             if self._zf_n_acc >= zf.update_interval:
-                if self._zf_cold_jit is None:
-                    self._zf_cold_jit = self._build_zf_cold_fn()
-                p2, _ = jax.tree_util.tree_flatten(self.params)
-                idx_leaves = [h["idx"] for h in self._zf_hot["leaves"]]
-                new_p, self.opt_state, self._zf_acc = self._zf_cold_jit(
-                    p2, self.opt_state, self._zf_acc, idx_leaves,
-                    self._zf_n_dev, jnp.int32(step),
-                )
-                self.params = jax.tree_util.tree_unflatten(tdef, new_p)
-                self._zf_n_acc = 0
-                self._zf_n_dev = jnp.int32(0)
+                self._zf_cold_boundary(tdef)
         metrics["loss"] = loss
         # same bounded async-dispatch window as the fused path
         self._inflight.append(metrics["loss"])
@@ -1306,6 +1357,8 @@ class Engine:
         self.skipped_steps = int(manifest["skipped_steps"])
         if load_lr_scheduler_states:
             self.lr_scheduler.load_state_dict(manifest["lr_scheduler"])
+        if self._zenflow:
+            self._zf_reset_transients()
         log_dist(
             f"loaded checkpoint {ckpt_dir} (saved at world_size="
             f"{manifest['world_size']}, now {self.topo.world_size})",

@@ -39,15 +39,18 @@ def _nblocks(size: int, block: int) -> int:
 
 def hot_k(size: int, ratio: float, block: int) -> int:
     """Number of hot blocks for a leaf: ceil(ratio * n_blocks), >= 1."""
+    import math
+
     nb = _nblocks(size, block)
-    return max(1, min(nb, int(round(ratio * nb))))
+    return max(1, min(nb, math.ceil(ratio * nb)))
 
 
 def init_hot_state(abstract_leaves, ratio: float, block: int) -> dict:
     """Device-resident selective-optimizer state (reference
     ``ZenFlowSelectiveAdamW`` per-param state): per leaf the selected block
-    ids and their Adam moments, plus one shared bias-correction counter that
-    resets on re-selection."""
+    ids, their Adam moments, and a per-block bias-correction counter (blocks
+    retained across re-selections keep their moments and counter; fresh
+    blocks start cold)."""
     per_leaf = []
     for leaf in abstract_leaves:
         k = hot_k(int(leaf.size), ratio, block)
@@ -55,8 +58,9 @@ def init_hot_state(abstract_leaves, ratio: float, block: int) -> dict:
             "idx": jnp.zeros((k,), jnp.int32),
             "m": jnp.zeros((k, block), jnp.float32),
             "v": jnp.zeros((k, block), jnp.float32),
+            "t": jnp.zeros((k,), jnp.int32),
         })
-    return {"leaves": per_leaf, "t": jnp.zeros((), jnp.int32)}
+    return {"leaves": per_leaf}
 
 
 def _to_blocks(x, block: int):
@@ -91,15 +95,15 @@ def hot_step(param_leaves, hot, grad_leaves, acc_leaves, lr, finite, *,
     writes are guarded by ``finite`` so an overflow step changes nothing
     (matching the dense paths' skip semantics).
     """
-    t = hot["t"] + jnp.where(finite, 1, 0)
-    bc1 = 1.0 - b1 ** t.astype(jnp.float32)
-    bc2 = 1.0 - b2 ** t.astype(jnp.float32)
     new_params, new_leaves, new_acc = [], [], []
     for p, h, g, acc in zip(param_leaves, hot["leaves"], grad_leaves, acc_leaves):
         shape, n = p.shape, int(p.size)
         gb = _to_blocks(g, block)
         pb = _to_blocks(p, block)
         idx = h["idx"]
+        t = h["t"] + jnp.where(finite, 1, 0)           # per-block counter
+        bc1 = 1.0 - b1 ** t.astype(jnp.float32)[:, None]
+        bc2 = 1.0 - b2 ** t.astype(jnp.float32)[:, None]
         gh = gb[idx]                                   # [k, block]
         m = b1 * h["m"] + (1.0 - b1) * gh
         v = b2 * h["v"] + (1.0 - b2) * jnp.square(gh)
@@ -113,10 +117,11 @@ def hot_step(param_leaves, hot, grad_leaves, acc_leaves, lr, finite, *,
             "idx": idx,
             "m": jnp.where(finite, m, h["m"]),
             "v": jnp.where(finite, v, h["v"]),
+            "t": t,
         })
         cold = gb.at[idx].set(0.0).reshape(-1)[:n].reshape(shape)
         new_acc.append(acc + jnp.where(finite, cold, 0.0))
-    return new_params, {"leaves": new_leaves, "t": t}, new_acc
+    return new_params, {"leaves": new_leaves}, new_acc
 
 
 def restore_hot(p_old, p_new, idx, block: int):
@@ -130,13 +135,26 @@ def restore_hot(p_old, p_new, idx, block: int):
 
 
 def reset_moments(hot: dict, new_idx: list) -> dict:
-    """Re-selection (reference select_interval boundary): newly selected
-    blocks start with fresh moments; the bias-correction counter restarts."""
-    leaves = [
-        {"idx": idx, "m": jnp.zeros_like(h["m"]), "v": jnp.zeros_like(h["v"])}
-        for h, idx in zip(hot["leaves"], new_idx)
-    ]
-    return {"leaves": leaves, "t": jnp.zeros((), jnp.int32)}
+    """Re-selection (reference select_interval boundary): blocks retained in
+    the hot set carry their moments and bias-correction counter over; only
+    newly selected blocks start cold. Matching is O(k log k) via sort +
+    searchsorted (no [k, k] comparison blow-up on large leaves)."""
+    leaves = []
+    for h, idx in zip(hot["leaves"], new_idx):
+        old_idx = h["idx"]
+        order = jnp.argsort(old_idx)
+        sorted_old = old_idx[order]
+        pos = jnp.clip(jnp.searchsorted(sorted_old, idx), 0,
+                       old_idx.shape[0] - 1)
+        hit = sorted_old[pos] == idx
+        src = order[pos]
+        leaves.append({
+            "idx": idx,
+            "m": jnp.where(hit[:, None], h["m"][src], 0.0),
+            "v": jnp.where(hit[:, None], h["v"][src], 0.0),
+            "t": jnp.where(hit, h["t"][src], 0).astype(jnp.int32),
+        })
+    return {"leaves": leaves}
 
 
 def hot_state_elements(hot: dict) -> int:

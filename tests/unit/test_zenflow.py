@@ -38,7 +38,7 @@ def test_hot_step_touches_only_hot_blocks():
     a = np.asarray(new_acc[0])
     assert (a[BLOCK:2 * BLOCK] == 0).all()            # hot coords excluded
     np.testing.assert_allclose(a[:BLOCK], 0.1)        # cold coords accumulate
-    assert int(new_hot["t"]) == 1
+    assert np.asarray(new_hot["leaves"][0]["t"]).tolist() == [1]
 
 
 def test_hot_step_overflow_is_a_noop():
@@ -52,7 +52,28 @@ def test_hot_step_overflow_is_a_noop():
         block=BLOCK, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0)
     np.testing.assert_array_equal(np.asarray(new_p[0]), np.asarray(p))
     assert (np.asarray(new_acc[0]) == 0).all()
-    assert int(new_hot["t"]) == 0
+    assert np.asarray(new_hot["leaves"][0]["t"]).tolist() == [0]
+
+
+def test_reselection_retains_overlapping_block_moments():
+    hot = zenflow.init_hot_state(
+        [jax.ShapeDtypeStruct((4 * BLOCK,), jnp.float32)], ratio=0.5, block=BLOCK)
+    h = hot["leaves"][0]
+    h["idx"] = jnp.array([3, 1], jnp.int32)
+    h["m"] = jnp.stack([jnp.full((BLOCK,), 3.0), jnp.full((BLOCK,), 1.0)])
+    h["v"] = h["m"] * 2
+    h["t"] = jnp.array([7, 5], jnp.int32)
+    out = zenflow.reset_moments(hot, [jnp.array([1, 2], jnp.int32)])["leaves"][0]
+    # block 1 retained (m=1, t=5); block 2 fresh
+    np.testing.assert_allclose(np.asarray(out["m"][0]), 1.0)
+    np.testing.assert_allclose(np.asarray(out["v"][0]), 2.0)
+    assert np.asarray(out["t"]).tolist() == [5, 0]
+    assert (np.asarray(out["m"][1]) == 0).all()
+
+
+def test_hot_k_uses_ceil():
+    # 29 blocks at 5% -> ceil(1.45) = 2
+    assert zenflow.hot_k(29 * BLOCK, 0.05, BLOCK) == 2
 
 
 def test_restore_hot():
@@ -143,10 +164,11 @@ class TestZenFlowEngine:
         losses = [float(engine.train_batch(batch)) for _ in range(10)]
         assert all(np.isfinite(losses))
         assert np.mean(losses[-3:]) < np.mean(losses[:3])
-        # cadence: after warmup (2 dense steps), 8 hot steps -> two cold
-        # boundaries at hot-steps 3 and 6, leaving 2 accumulated
+        # cadence: warmup steps 0-1 dense (selection at step 1); hot steps
+        # 2-9 with cold boundaries when the window fills (steps 4, 7) and a
+        # flush at the step-9 re-selection, leaving 1 accumulated (step 9)
         assert engine._zf_selected
-        assert engine._zf_n_acc == 2
+        assert engine._zf_n_acc == 1
         # params stay finite
         for leaf in jax.tree_util.tree_leaves(engine.params):
             assert bool(jnp.isfinite(leaf).all())
@@ -164,3 +186,18 @@ class TestZenFlowEngine:
         engine = _zf_engine()
         with pytest.raises(NotImplementedError):
             engine.backward(_batches(1)[0])
+
+    def test_load_checkpoint_resets_selective_state(self, tmp_path):
+        engine = _zf_engine(update_interval=3, warmup=1)
+        batch = _batches(1)[0]
+        for _ in range(3):
+            engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path / "ck"))
+        for _ in range(2):  # leave a partially-filled cold window
+            engine.train_batch(batch)
+        assert engine._zf_n_acc > 0
+        engine.load_checkpoint(str(tmp_path / "ck"))
+        assert engine._zf_n_acc == 0 and engine._zf_acc is None
+        assert not engine._zf_selected
+        more = [float(engine.train_batch(batch)) for _ in range(3)]
+        assert all(np.isfinite(more))
