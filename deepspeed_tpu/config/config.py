@@ -289,12 +289,27 @@ class SequenceParallelConfig(ConfigBase):
     tiled_mlp: bool = False
     tiled_logits: bool = False
     tile_size: int = 1024  # sequence tokens per ALST compute tile
+    # FPDT chunked attention with host-offloaded residuals (reference
+    # sequence/fpdt_layer.py): 0 = off; otherwise chunks (>= 2) over the
+    # attention-visible sequence — under mode=ulysses that is the FULL
+    # post-all-to-all sequence, not the per-rank shard, so size it against
+    # the global context length.
+    fpdt_chunks: int = 0
+    fpdt_offload: bool = True
 
     def _validate(self, path: str = "") -> None:
         if self.mode not in ("ulysses", "ring"):
             raise ConfigError(f"{path}mode: must be ulysses|ring")
         if self.tile_size <= 0:
             raise ConfigError(f"{path}tile_size: must be positive")
+        if self.fpdt_chunks < 0 or self.fpdt_chunks == 1:
+            raise ConfigError(
+                f"{path}fpdt_chunks: must be 0 (off) or >= 2, got "
+                f"{self.fpdt_chunks}")
+        if self.fpdt_chunks and self.mode == "ring":
+            raise ConfigError(
+                f"{path}fpdt_chunks: FPDT composes with mode=ulysses only "
+                "(ring already chunks the KV loop across the ring)")
 
 
 @dataclass

@@ -61,6 +61,11 @@ class ShardCtx:
     # 0 = off; otherwise tokens per tile
     loss_tile_size: int = 0
     mlp_tile_size: int = 0
+    # FPDT chunked attention w/ host-offloaded residuals (reference
+    # sequence/fpdt_layer.py:545): 0 = off; otherwise chunks (>= 2) over the
+    # attention-visible sequence (under Ulysses: the full gathered sequence)
+    fpdt_chunks: int = 0
+    fpdt_offload: bool = True
 
     @property
     def sp_degree(self) -> int:
@@ -98,6 +103,22 @@ class ShardCtx:
         impl = impl or self.attn_impl
         from deepspeed_tpu.ops.attention import attention as local_attention
 
+        if self.fpdt_chunks > 1:
+            from deepspeed_tpu.parallel.fpdt import fpdt_attention
+
+            # config True = offload when the backend supports it (probe);
+            # False = chunked compute only, residuals stay in HBM
+            local = lambda q, k, v: fpdt_attention(  # noqa: E731
+                q, k, v, self.fpdt_chunks, causal=causal,
+                offload=None if self.fpdt_offload else False)
+            if self.sp_degree <= 1:
+                return local(q, k, v)
+            # FPDT composes with Ulysses (reference FPDT runs on the
+            # post-all-to-all full-sequence head-sharded layout)
+            from deepspeed_tpu.parallel.ulysses import ulysses_attention
+
+            return ulysses_attention(q, k, v, self.mesh, causal=causal,
+                                     local_fn=local)
         if self.sp_degree <= 1:
             return local_attention(q, k, v, causal=causal, impl=impl)
         if self.sp_mode == "ring":

@@ -33,12 +33,16 @@ def _constrain(mesh, x, spec):
 
 
 def ulysses_attention(q, k, v, mesh, causal: bool = True, impl: str = "auto",
-                      scale=None):
+                      scale=None, local_fn=None):
     """[B, S, H, D] q/k/v seq-sharded in, seq-sharded out; attention computed
-    head-sharded over the full sequence (reference ``DistributedAttention``)."""
+    head-sharded over the full sequence (reference ``DistributedAttention``,
+    which likewise wraps *any* local attention impl — pass ``local_fn`` to
+    substitute one, e.g. FPDT chunked attention)."""
+    attn = local_fn or (lambda q, k, v: _local_attention(
+        q, k, v, causal=causal, impl=impl, scale=scale))
     sp = mesh.shape.get(AXIS_SEQ, 1)
     if sp <= 1:
-        return _local_attention(q, k, v, causal=causal, impl=impl, scale=scale)
+        return attn(q, k, v)
     b_ax = _batch_axes(mesh)
 
     def head_spec(x):
@@ -53,7 +57,7 @@ def ulysses_attention(q, k, v, mesh, causal: bool = True, impl: str = "auto",
     q = _constrain(mesh, q, head_spec(q))
     k = _constrain(mesh, k, head_spec(k))
     v = _constrain(mesh, v, head_spec(v))
-    out = _local_attention(q, k, v, causal=causal, impl=impl, scale=scale)
+    out = attn(q, k, v)
     # head->seq inverse all-to-all
     return _constrain(mesh, out, seq_spec)
 

@@ -101,6 +101,8 @@ class Engine:
             remat_policy=_resolve_remat_policy(config.activation_checkpointing.policy),
             loss_tile_size=sp_cfg.tile_size if sp_cfg.tiled_logits else 0,
             mlp_tile_size=sp_cfg.tile_size if sp_cfg.tiled_mlp else 0,
+            fpdt_chunks=sp_cfg.fpdt_chunks,
+            fpdt_offload=sp_cfg.fpdt_offload,
         )
         self.model_spec = model(self.shard_ctx) if callable(model) else model
         self.training_dataloader = training_data
