@@ -1,0 +1,24 @@
+"""Compression-aware training (reference ``deepspeed/compression/``):
+scheduled quantization-aware training + structured/unstructured pruning,
+applied functionally to the param pytree inside the jitted step.
+"""
+
+from deepspeed_tpu.compression.config import CompressionConfig
+from deepspeed_tpu.compression.functional import (
+    fake_quantize,
+    head_prune_mask,
+    magnitude_prune_mask,
+    quantize_activation,
+    row_prune_mask,
+)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+
+__all__ = [
+    "CompressionConfig",
+    "CompressionScheduler",
+    "fake_quantize",
+    "quantize_activation",
+    "magnitude_prune_mask",
+    "row_prune_mask",
+    "head_prune_mask",
+]

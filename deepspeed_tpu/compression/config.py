@@ -1,0 +1,73 @@
+"""Compression config parsing.
+
+Accepts the reference's ``compression_training`` ds_config shape
+(``/root/reference/deepspeed/compression/config.py``,
+``constants.py``): per-method ``shared_parameters`` (enabled,
+schedule_offset, ...) plus ``different_groups`` mapping a group name to
+``{params: {...}, modules: [patterns]}``. Module patterns match against the
+"/"-joined param pytree path here (the functional analog of the reference's
+module-name matching in ``compress.py get_module_name``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+METHODS = (
+    "weight_quantization",
+    "activation_quantization",
+    "sparse_pruning",
+    "row_pruning",
+    "head_pruning",
+    "channel_pruning",
+)
+
+
+@dataclass
+class CompressionGroup:
+    name: str
+    params: dict
+    modules: list  # regex patterns over "/"-joined param paths
+
+
+@dataclass
+class CompressionMethod:
+    enabled: bool = False
+    schedule_offset: int = 0
+    shared: dict = field(default_factory=dict)
+    groups: list = field(default_factory=list)  # [CompressionGroup]
+
+
+@dataclass
+class CompressionConfig:
+    methods: dict = field(default_factory=dict)  # name -> CompressionMethod
+
+    @classmethod
+    def from_dict(cls, data: dict | None) -> "CompressionConfig":
+        data = data or {}
+        methods = {}
+        for name in METHODS:
+            block = data.get(name) or {}
+            shared = dict(block.get("shared_parameters") or {})
+            groups = []
+            for gname, g in (block.get("different_groups") or {}).items():
+                g = dict(g or {})
+                groups.append(CompressionGroup(
+                    name=gname,
+                    params=dict(g.get("params") or {}),
+                    modules=list(g.get("modules") or ["*"]),
+                ))
+            methods[name] = CompressionMethod(
+                enabled=bool(shared.get("enabled", False)),
+                schedule_offset=int(shared.get("schedule_offset", 0)),
+                shared=shared,
+                groups=groups,
+            )
+        return cls(methods=methods)
+
+    def enabled_methods(self) -> list:
+        return [n for n, m in self.methods.items() if m.enabled]
+
+    @property
+    def any_enabled(self) -> bool:
+        return bool(self.enabled_methods())

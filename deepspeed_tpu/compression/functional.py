@@ -1,0 +1,77 @@
+"""Functional compression transforms (jittable, traced-schedule friendly).
+
+Role parity with the reference's ``compression/basic_layer.py`` layer
+machinery (LinearLayer_Compress and friends): the reference mutates wrapped
+modules; here every technique is a pure function on a weight (or a mask),
+applied to the param pytree inside the jitted step, so the schedule (bits,
+ratios) can be *traced* values and advance without recompilation.
+
+- ``fake_quantize``: symmetric per-group fake quantization with a
+  straight-through estimator (QAT; reference weight_quantization path).
+- ``quantize_activation``: same math for activations.
+- ``magnitude_prune_mask`` / ``row_prune_mask`` / ``head_prune_mask`` /
+  ``channel_prune_mask``: unstructured and structured pruning masks by
+  magnitude (reference sparse/row/head/channel pruning).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(x, fx):
+    """Straight-through estimator: forward fx, gradient of identity."""
+    return x + jax.lax.stop_gradient(fx - x)
+
+
+def fake_quantize(w, bits, groups: int = 1):
+    """Symmetric per-group fake quant, STE gradients. ``bits`` may be a
+    traced scalar (the annealing schedule runs inside jit)."""
+    bits = jnp.asarray(bits, jnp.float32)
+    n = jnp.maximum(2.0 ** (bits - 1.0) - 1.0, 1.0)
+    flat = w.reshape(groups, -1).astype(jnp.float32)
+    scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / n
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(flat / scale) * scale
+    return _ste(w, q.reshape(w.shape).astype(w.dtype))
+
+
+def quantize_activation(x, bits, groups: int = 1):
+    """Activation fake quant (reference activation_quantization); no STE
+    needed for the value path but kept for symmetric gradients."""
+    return fake_quantize(x, bits, groups)
+
+
+def magnitude_prune_mask(w, ratio):
+    """Zero the lowest-|w| ``ratio`` fraction (unstructured sparse pruning).
+    ``ratio`` may be traced."""
+    flat = jnp.abs(w.reshape(-1).astype(jnp.float32))
+    thresh = jnp.quantile(flat, jnp.clip(ratio, 0.0, 1.0))
+    return (jnp.abs(w) > thresh.astype(w.dtype)).astype(w.dtype)
+
+
+def row_prune_mask(w, ratio):
+    """Zero whole output rows by L1 norm (reference row_pruning; w is
+    [in, out] here, rows = output features)."""
+    norms = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=0)
+    thresh = jnp.quantile(norms, jnp.clip(ratio, 0.0, 1.0))
+    return (norms > thresh).astype(w.dtype)[None, :]
+
+
+def channel_prune_mask(w, ratio):
+    """Zero input channels by L1 norm (reference channel_pruning)."""
+    norms = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=-1)
+    thresh = jnp.quantile(norms, jnp.clip(ratio, 0.0, 1.0))
+    return (norms > thresh).astype(w.dtype)[..., None]
+
+
+def head_prune_mask(w, ratio, num_heads: int):
+    """Zero whole attention heads of an output projection
+    ``[H*Dh, out]`` by L1 norm (reference head_pruning on attn.dense)."""
+    hd = w.shape[0] // num_heads
+    norms = jnp.sum(jnp.abs(w.reshape(num_heads, hd, -1).astype(jnp.float32)),
+                    axis=(1, 2))
+    thresh = jnp.quantile(norms, jnp.clip(ratio, 0.0, 1.0))
+    keep = (norms > thresh).astype(w.dtype)
+    return jnp.repeat(keep, hd)[:, None]

@@ -1,0 +1,160 @@
+"""Compression scheduler: which technique is active at which step, and how
+hard (reference ``compression/scheduler.py compression_scheduler`` +
+``basic_layer.py`` bit annealing).
+
+Schedules are computed as traced scalars from the step, so one compiled
+train program serves the whole schedule:
+- activation gate: ``step >= schedule_offset`` as a 0/1 float,
+- QAT bit annealing: ``start_bits`` down to ``target_bits``, one bit per
+  ``quantization_period`` steps after the offset (reference
+  LinearLayer_Compress bit-reduction cadence).
+
+``apply_to_params`` maps the configured groups onto the param pytree by
+"/"-joined path regex and applies fake-quant / pruning masks — the
+functional analog of the reference's module-wrapper surgery
+(``compress.py``).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.config import CompressionConfig
+from deepspeed_tpu.compression import functional as F
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        key = getattr(k, "key", None)
+        if key is None:
+            key = getattr(k, "idx", "")
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def _match(patterns, path: str) -> bool:
+    for p in patterns:
+        if p == "*" or re.search(p, path):
+            return True
+    return False
+
+
+class CompressionScheduler:
+    def __init__(self, config: CompressionConfig | dict | None,
+                 num_heads: int = 0):
+        if not isinstance(config, CompressionConfig):
+            config = CompressionConfig.from_dict(config)
+        self.config = config
+        self.num_heads = num_heads
+        self.training_steps = 0
+        if config.methods["activation_quantization"].enabled:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                "activation_quantization is parsed but NOT applied by the "
+                "engine's param-compression path — wire "
+                "deepspeed_tpu.compression.quantize_activation into the "
+                "model's forward where activations should be quantized.")
+
+    # ------------------------------------------------------- reference API
+    def step(self, step_zero_check: bool = False) -> None:
+        if not step_zero_check:
+            self.training_steps += 1
+
+    def is_active(self, method: str, step=None):
+        """0/1 gate for a method at ``step`` (traced-friendly)."""
+        m = self.config.methods.get(method)
+        if m is None or not m.enabled:
+            return jnp.float32(0.0) if step is not None else False
+        if step is None:
+            return self.training_steps >= m.schedule_offset
+        return (step >= m.schedule_offset).astype(jnp.float32)
+
+    def current_bits(self, group_params: dict, method: str, step):
+        """Annealed bit width at ``step`` (traced)."""
+        m = self.config.methods[method]
+        start = float(group_params.get("start_bits", 8))
+        target = float(group_params.get("target_bits", start))
+        period = float(group_params.get("quantization_period", 1) or 1)
+        done = jnp.maximum(step.astype(jnp.float32) - m.schedule_offset, 0.0)
+        return jnp.maximum(start - jnp.floor(done / period), target)
+
+    # ------------------------------------------------------- param surgery
+    def apply_to_params(self, params, step):
+        """Apply every active technique to matching param leaves; returns the
+        compressed pytree (pure; call inside the jitted loss)."""
+        cfg = self.config
+        if not cfg.any_enabled:
+            return params
+
+        def per_layer(fn, w):
+            """Stacked layer leaves ([L, in, out]) get the technique applied
+            per layer (vmap over the leading layer dim); plain 2D weights
+            directly."""
+            return jax.vmap(fn)(w) if w.ndim >= 3 else fn(w)
+
+        def transform(path, leaf):
+            if leaf.ndim < 2:  # norms/biases are never compressed
+                return leaf
+            p = _path_str(path)
+            out = leaf
+            wq = cfg.methods["weight_quantization"]
+            if wq.enabled:
+                for g in wq.groups:
+                    if _match(g.modules, p):
+                        bits = self.current_bits(g.params, "weight_quantization", step)
+                        gate = self.is_active("weight_quantization", step)
+                        qg = int(g.params.get(
+                            "quantize_groups", wq.shared.get("quantize_groups", 1)))
+                        fq = per_layer(
+                            lambda w: F.fake_quantize(w, bits, qg), out)
+                        out = jnp.where(gate > 0, fq, out)
+                        break
+            sp = cfg.methods["sparse_pruning"]
+            if sp.enabled:
+                for g in sp.groups:
+                    if _match(g.modules, p):
+                        r = 1.0 - float(g.params.get("dense_ratio", 0.5))
+                        gate = self.is_active("sparse_pruning", step)
+                        pruned = per_layer(
+                            lambda w: w * F.magnitude_prune_mask(w, r), out)
+                        out = jnp.where(gate > 0, pruned, out)
+                        break
+            rp = cfg.methods["row_pruning"]
+            if rp.enabled:
+                for g in rp.groups:
+                    if _match(g.modules, p):
+                        r = 1.0 - float(g.params.get("dense_ratio", 0.5))
+                        gate = self.is_active("row_pruning", step)
+                        pruned = per_layer(
+                            lambda w: w * F.row_prune_mask(w, r), out)
+                        out = jnp.where(gate > 0, pruned, out)
+                        break
+            hp = cfg.methods["head_pruning"]
+            if hp.enabled and self.num_heads:
+                for g in hp.groups:
+                    if _match(g.modules, p):
+                        r = 1.0 - float(g.params.get("dense_ratio", 0.5))
+                        gate = self.is_active("head_pruning", step)
+                        nh = self.num_heads
+                        pruned = per_layer(
+                            lambda w: w * F.head_prune_mask(w, r, nh), out)
+                        out = jnp.where(gate > 0, pruned, out)
+                        break
+            cp = cfg.methods["channel_pruning"]
+            if cp.enabled:
+                for g in cp.groups:
+                    if _match(g.modules, p):
+                        r = 1.0 - float(g.params.get("dense_ratio", 0.5))
+                        gate = self.is_active("channel_pruning", step)
+                        pruned = per_layer(
+                            lambda w: w * F.channel_prune_mask(w, r), out)
+                        out = jnp.where(gate > 0, pruned, out)
+                        break
+            return out
+
+        return jax.tree_util.tree_map_with_path(transform, params)

@@ -379,6 +379,36 @@ class CheckpointConfig(ConfigBase):
 
 
 @dataclass
+class ProgressiveLayerDropConfig(ConfigBase):
+    """PLD schedule (reference ``runtime/progressive_layer_drop.py`` +
+    ds_config key ``progressive_layer_drop``)."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+    def _validate(self, path: str = "") -> None:
+        if not (0.0 < self.theta <= 1.0):
+            raise ConfigError(f"{path}theta: must be in (0, 1], got {self.theta}")
+
+
+@dataclass
+class EigenvalueConfig(ConfigBase):
+    """Curvature probe (reference ``runtime/eigenvalue.py`` + engine
+    ``eigenvalue`` config block): blockwise top-Hessian-eigenvalue power
+    iteration, used to modulate quantization/compression schedules."""
+
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "layers"
+    layer_num: int = 0
+
+
+@dataclass
 class DataEfficiencyConfig(ConfigBase):
     enabled: bool = False
     curriculum_learning: dict = field(default_factory=dict)
@@ -444,6 +474,12 @@ class Config(ConfigBase):
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     debug: DebugConfig = field(default_factory=DebugConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = field(
+        default_factory=ProgressiveLayerDropConfig)
+    eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
+    # reference ds_config["compression_training"] shape, parsed by
+    # deepspeed_tpu.compression.CompressionConfig (QAT + pruning schedules)
+    compression_training: dict = field(default_factory=dict)
 
     _auto_fields: ClassVar[set] = {
         "train_batch_size",

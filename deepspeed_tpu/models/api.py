@@ -79,10 +79,40 @@ class ShardCtx:
             return 1
         return int(self.mesh.shape.get("pipeline", 1))
 
-    def layer_stack(self, layer_fn, stacked_params, x):
+    def layer_stack(self, layer_fn, stacked_params, x, pld_theta=None,
+                    pld_rng=None):
         """Run the decoder stack: plain ``lax.scan`` normally, the collective
-        microbatch pipeline when the ``pipeline`` mesh axis is active."""
+        microbatch pipeline when the ``pipeline`` mesh axis is active.
+
+        With ``pld_theta`` (a traced scalar) + ``pld_rng``, layers are
+        stochastically skipped per Progressive Layer Drop
+        (``runtime/progressive_layer_drop.py``): depth-scaled keep
+        probability, ``lax.cond`` so dropped layers skip their FLOPs, and
+        stochastic-depth rescaling of the kept residual delta."""
         import jax.lax as lax
+
+        if pld_theta is not None:
+            if self.pp_degree > 1:
+                raise ValueError("progressive layer drop does not compose "
+                                 "with pipeline parallelism")
+            leaves = jax.tree_util.tree_leaves(stacked_params)
+            n_layers = leaves[0].shape[0]
+
+            def body(carry, inp):
+                lp, i = inp
+                frac = (i.astype(jnp.float32) + 1.0) / n_layers
+                keep_p = 1.0 - frac * (1.0 - pld_theta)
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(pld_rng, i), keep_p)
+
+                def kept(c):
+                    delta = layer_fn(c, lp) - c
+                    return c + delta / keep_p.astype(delta.dtype)
+
+                return lax.cond(keep, kept, lambda c: c, carry), None
+
+            return lax.scan(body, x,
+                            (stacked_params, jnp.arange(n_layers)))[0]
 
         if self.pp_degree <= 1:
             return lax.scan(lambda c, lp: (layer_fn(c, lp), None), x, stacked_params)[0]

@@ -150,7 +150,8 @@ def _decoder_layer(cfg: LlamaConfig, ctx: ShardCtx, attn_impl: str,
 
 def hidden_states(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
                   ctx: ShardCtx | None = None, attn_impl: str = "auto",
-                  remat_policy=None, remat: bool = False) -> jnp.ndarray:
+                  remat_policy=None, remat: bool = False,
+                  pld_theta=None, pld_rng=None) -> jnp.ndarray:
     """[B, S] int tokens -> [B, S, D] final (post-norm) hidden states."""
     ctx = ctx or ShardCtx()
     x = ctx.embed_lookup(params["embed"], input_ids, "batch", "seq", "embed_act")
@@ -159,7 +160,8 @@ def hidden_states(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
     if remat:
         layer = jax.checkpoint(layer, policy=remat_policy)
 
-    x = ctx.layer_stack(layer, params["layers"], x)
+    x = ctx.layer_stack(layer, params["layers"], x,
+                        pld_theta=pld_theta, pld_rng=pld_rng)
     return rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
 
 
@@ -169,11 +171,13 @@ def lm_head(cfg: LlamaConfig, params: dict) -> jnp.ndarray:
 
 def forward(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
             ctx: ShardCtx | None = None, attn_impl: str = "auto",
-            remat_policy=None, remat: bool = False) -> jnp.ndarray:
+            remat_policy=None, remat: bool = False,
+            pld_theta=None, pld_rng=None) -> jnp.ndarray:
     """[B, S] int tokens -> [B, S, V] logits. Decoder is a scan over the layer stack."""
     ctx = ctx or ShardCtx()
     x = hidden_states(cfg, params, input_ids, ctx=ctx, attn_impl=attn_impl,
-                      remat_policy=remat_policy, remat=remat)
+                      remat_policy=remat_policy, remat=remat,
+                      pld_theta=pld_theta, pld_rng=pld_rng)
     logits = x @ lm_head(cfg, params).astype(x.dtype)
     return ctx.constrain(logits, "batch", "seq", "vocab_act")
 
@@ -393,18 +397,23 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
                   remat=remat, remat_policy=remat_policy)
 
     def loss_fn(params, batch, rng=None):
-        del rng  # no dropout in llama
+        # progressive layer drop: the engine injects a traced theta into the
+        # batch (runtime/progressive_layer_drop.py); rng drives the drops
+        pld = batch.get("pld_theta")
+        if pld is not None and rng is None:
+            raise ValueError("progressive layer drop needs the loss rng")
         if ctx.loss_tile_size:
             from deepspeed_tpu.parallel.sequence_tiling import tiled_causal_lm_loss
 
             x = hidden_states(cfg, params, batch["input_ids"], ctx=ctx,
                               attn_impl=attn_impl, remat=remat,
-                              remat_policy=remat_policy)
+                              remat_policy=remat_policy,
+                              pld_theta=pld, pld_rng=rng)
             return tiled_causal_lm_loss(
-                x, lm_head(cfg, params), batch["input_ids"], batch.get("labels"),
-                tile_size=ctx.loss_tile_size,
+                x, lm_head(cfg, params), batch["input_ids"],
+                batch.get("labels"), tile_size=ctx.loss_tile_size,
             )
-        logits = fwd(params, batch["input_ids"])
+        logits = fwd(params, batch["input_ids"], pld_theta=pld, pld_rng=rng)
         return causal_lm_loss(logits, batch["input_ids"], batch.get("labels"))
 
     axes = dict(PARAM_LOGICAL_AXES)

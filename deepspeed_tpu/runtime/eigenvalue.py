@@ -1,0 +1,144 @@
+"""Blockwise Hessian top-eigenvalue probe (curvature estimation).
+
+Role parity with the reference ``runtime/eigenvalue.py`` (``Eigenvalue``):
+per-layer-block power iteration on the loss Hessian, used to modulate
+quantization/compression schedules (higher curvature -> more conservative
+compression). The reference needs ``torch.autograd.grad`` with
+``retain_graph`` and filters params by grad_fn; here a Hessian-vector product
+is one ``jax.jvp`` through ``jax.grad`` — no graph bookkeeping, and the whole
+iteration jit-compiles.
+
+Blocks: the decoder stack is a *stacked* pytree (leading layer dim), so
+"layer block l" is slice ``l`` of every leaf under ``layer_name`` — the
+analog of the reference's ``get_layers(module)[block]``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Eigenvalue:
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "layers", layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def _hvp_fn(self, loss_fn, params, batch, rng):
+        grad_fn = jax.grad(lambda p: loss_fn(p, batch, rng))
+
+        @jax.jit
+        def hvp(v):
+            # normalization/nan_to_num promote the direction to fp32;
+            # tangents must match the primal dtype exactly
+            v = jax.tree_util.tree_map(lambda t, p: t.astype(p.dtype),
+                                       v, params)
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        return hvp
+
+    def _block_ops(self, params, block: int):
+        """Mask/init helpers confining a direction vector to layer ``block``
+        of the stacked ``layer_name`` subtree."""
+        name = self.layer_name
+
+        def init(rng):
+            leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+            out = []
+            for i, (path, leaf) in enumerate(leaves):
+                in_block = any(getattr(k, "key", None) == name for k in path)
+                r = jax.random.fold_in(rng, i)
+                # tangents must match the primal dtype exactly (jvp contract)
+                if in_block:
+                    blk = jax.random.normal(r, leaf.shape[1:], leaf.dtype)
+                    v = jnp.zeros(leaf.shape, leaf.dtype).at[block].set(blk)
+                else:
+                    v = jnp.zeros(leaf.shape, leaf.dtype)
+                out.append(v)
+            return jax.tree_util.tree_unflatten(treedef, [x for x in out])
+
+        def mask(tree):
+            def m(path, leaf):
+                in_block = any(getattr(k, "key", None) == name for k in path)
+                if not in_block:
+                    return jnp.zeros_like(leaf)
+                keep = jnp.zeros((leaf.shape[0],), leaf.dtype).at[block].set(1)
+                return leaf * keep.reshape((-1,) + (1,) * (leaf.ndim - 1))
+
+            return jax.tree_util.tree_map_with_path(m, tree)
+
+        return init, mask
+
+    @staticmethod
+    def _inner(a, b):
+        return sum(jnp.vdot(x, y) for x, y in
+                   zip(jax.tree_util.tree_leaves(a),
+                       jax.tree_util.tree_leaves(b)))
+
+    def _normalize(self, v):
+        norm = jnp.sqrt(jnp.real(self._inner(v, v))) + self.stability
+        return jax.tree_util.tree_map(lambda x: x / norm, v)
+
+    def compute_eigenvalue(self, loss_fn, params, batch, rng=None,
+                           scale: float = 1.0) -> list:
+        """Top Hessian eigenvalue per layer block (reference
+        ``compute_eigenvalue`` power-iteration loop, convergence criterion
+        included). Returns ``layer_num`` floats, post-processed to [0, 1]
+        (max-normalized; invalid -> 1.0, reference ``post_process``)."""
+        from deepspeed_tpu.utils.logging import log_dist
+
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        n = self.layer_num
+        if n <= 0:
+            leaves = [leaf for path, leaf in
+                      jax.tree_util.tree_flatten_with_path(params)[0]
+                      if any(getattr(k, "key", None) == self.layer_name
+                             for k in path)]
+            if not leaves:
+                log_dist("eigenvalue: no stacked layer subtree named "
+                         f"{self.layer_name!r}; probe disabled", ranks=[0])
+                return []
+            n = int(leaves[0].shape[0])
+
+        hvp = self._hvp_fn(loss_fn, params, batch, rng)
+        values = []
+        for block in range(n):
+            init, mask = self._block_ops(params, block)
+            v = self._normalize(init(jax.random.fold_in(rng, 1000 + block)))
+            ev_cur, ev_prev, i = 1.0, 0.0, 0
+            while (i < self.max_iter and abs(ev_cur) > 0
+                   and abs((ev_cur - ev_prev) / ev_cur) >= self.tol):
+                ev_prev = ev_cur
+                hv = mask(hvp(v))
+                hv = jax.tree_util.tree_map(
+                    lambda x: jnp.nan_to_num(x.astype(jnp.float32)), hv)
+                ev_cur = float(jnp.real(self._inner(hv, v)))
+                v = self._normalize(hv)
+                v = jax.tree_util.tree_map(lambda x: x / scale, v)
+                i += 1
+            values.append(ev_cur * scale)
+            if self.verbose:
+                log_dist(f"block {block}: power iterations {i}, "
+                         f"eigenvalue {ev_cur * scale:.4e}", ranks=[0])
+        return self.post_process(values)
+
+    @staticmethod
+    def post_process(values: list) -> list:
+        """Map to [0, 1]; non-finite/non-positive entries -> 1.0 (the
+        conservative choice, reference ``post_process``)."""
+        import math
+
+        finite = [v for v in values if math.isfinite(v) and v > 0]
+        if not finite:
+            return [1.0] * len(values)
+        mx = max(finite)
+        return [v / mx if (math.isfinite(v) and v > 0) else 1.0
+                for v in values]

@@ -269,6 +269,19 @@ class Engine:
 
         self.monitor = MonitorMaster(config.monitor)
 
+        # compression-aware training (reference deepspeed/compression/):
+        # scheduled QAT + pruning applied to the compute-cast params
+        self._compression = None
+        if config.compression_training:
+            from deepspeed_tpu.compression import CompressionScheduler
+
+            heads = (self.model_spec.logical_dim_units or {}).get("heads", 0)
+            self._compression = CompressionScheduler(
+                config.compression_training, num_heads=int(heads))
+            log_dist(
+                "compression_training: "
+                f"{self._compression.config.enabled_methods()}", ranks=[0])
+
         # jax.profiler capture window + debug-nans trap (reference nvtx
         # instrumentation / sanity-check config, SURVEY §5.1-5.2)
         from deepspeed_tpu.utils.tracing import StepTracer
@@ -403,11 +416,18 @@ class Engine:
             ns,
         )
 
-    def _microbatch_grads(self, params, mb, rng, scale):
+    def _microbatch_grads(self, params, mb, rng, scale, step=None):
         """Scaled-loss grads for one microbatch, fp32, ZeRO-sharded."""
         cparams = precision.cast_to_compute(params, self.config.compute_dtype)
 
         def scaled_loss(cp):
+            if self._compression is not None and step is not None:
+                # QAT/pruning INSIDE the tape so masks gate gradients the
+                # way the reference's module wrappers do (pruned coords get
+                # zero grads; fake-quant flows STE). Runs per microbatch —
+                # it must sit inside each microbatch's grad tape, so it
+                # cannot be hoisted out of the GAS scan.
+                cp = self._compression.apply_to_params(cp, step)
             loss = self.model_spec.loss_fn(cp, mb, rng)
             return loss * scale
 
@@ -487,10 +507,20 @@ class Engine:
         # derive the step's rng on-device: no host random.split round trip
         rng = jax.random.fold_in(base_rng, step)
 
+        if self.config.progressive_layer_drop.enabled:
+            # inject the traced theta(t) so the drop schedule advances
+            # without recompilation (runtime/progressive_layer_drop.py)
+            from deepspeed_tpu.runtime.progressive_layer_drop import pld_theta
+
+            pld_cfg = self.config.progressive_layer_drop
+            theta = pld_theta(step, pld_cfg.theta, pld_cfg.gamma)
+            batch = dict(batch)
+            batch["pld_theta"] = jnp.broadcast_to(theta, (gas,))
+
         if gas == 1:
             # fast path: no accumulation buffer, no scan machinery
             mb = jax.tree_util.tree_map(lambda x: x[0], batch)
-            loss, acc = self._microbatch_grads(params, mb, rng, scale)
+            loss, acc = self._microbatch_grads(params, mb, rng, scale, step=step)
             losses = loss[None]
         else:
             if getattr(self, "_inside_manual_region", False):
@@ -508,7 +538,8 @@ class Engine:
             def micro(acc, idx_mb):
                 idx, mb = idx_mb
                 r = jax.random.fold_in(rng, idx)
-                loss, grads = self._microbatch_grads(params, mb, r, scale)
+                loss, grads = self._microbatch_grads(params, mb, r, scale,
+                                                     step=step)
                 return jax.tree_util.tree_map(jnp.add, acc, grads), loss
 
             acc, losses = jax.lax.scan(micro, acc0, (jnp.arange(gas), batch))
@@ -1031,11 +1062,14 @@ class Engine:
         Returns the (unscaled) loss. Gradients live in a persistent buffer
         sharded per the ZeRO plan until ``step()`` consumes them.
         """
-        if self._offload_mode == "nvme" or self._qgrad or self._zenflow:
+        if (self._offload_mode == "nvme" or self._qgrad or self._zenflow
+                or self.config.progressive_layer_drop.enabled
+                or self._compression is not None):
             raise NotImplementedError(
                 "the fwd/bwd/step parity path does not support NVMe-offloaded "
-                "optimizer state, quantized gradient reduction, or zenflow; "
-                "use train_batch()"
+                "optimizer state, quantized gradient reduction, zenflow, "
+                "progressive layer drop, or compression training; use "
+                "train_batch()"
             )
         if self.config.debug.sanity_checks:
             micro_total = (self.config.train_batch_size or 0) // self.gas or None
@@ -1085,6 +1119,23 @@ class Engine:
         self._acc_grads = None
         self._acc_count = 0
         self._after_step(metrics)
+
+    def compute_eigenvalue(self, batch: dict):
+        """Blockwise Hessian top-eigenvalue probe over one microbatch
+        (reference engine ``eigenvalue`` integration at the GAS boundary:
+        ``runtime/eigenvalue.py``; feeds quantization/compression schedules)."""
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        e = self.config.eigenvalue
+        probe = Eigenvalue(
+            verbose=e.verbose, max_iter=e.max_iter, tol=e.tol,
+            stability=e.stability,
+            gas_boundary_resolution=e.gas_boundary_resolution,
+            layer_name=e.layer_name, layer_num=e.layer_num)
+        cparams = precision.cast_to_compute(self.params, self.config.compute_dtype)
+        return probe.compute_eigenvalue(
+            self.model_spec.loss_fn, cparams,
+            self._put_microbatch(batch), self._next_rng())
 
     def _sanity_check_batch(self, batch: dict, expected: int | None = None) -> None:
         """Host-side semantic checks (reference ``enable_sanity_checks`` /

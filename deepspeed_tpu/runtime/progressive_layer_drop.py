@@ -1,0 +1,56 @@
+"""Progressive Layer Drop (PLD).
+
+Role parity with the reference ``runtime/progressive_layer_drop.py``
+(``ProgressiveLayerDrop``: the global keep-probability schedule
+``theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar``) applied to
+transformer training as in the PLD paper (arXiv:2010.13369): later layers are
+dropped with higher probability, and the schedule anneals from keep-everything
+(theta=1) toward ``theta_bar``.
+
+TPU-native mechanism: the reference passes ``pld_theta`` into an eager
+module's forward; here the decoder runs as one ``lax.scan`` over the stacked
+layer params, so the drop is a ``lax.cond`` inside the scan body — XLA
+executes only the taken branch, so a dropped layer really skips its FLOPs.
+Depth scaling and expectation-preserving rescale follow stochastic depth:
+layer ``l`` of ``L`` keeps with probability ``1 - (l+1)/L * (1 - theta(t))``
+and, when kept, its residual delta is scaled by ``1/keep_prob``.
+
+The per-step theta reaches the model as a traced scalar in the batch dict
+(``batch["pld_theta"]``, injected by the engine inside the jitted step), so
+the schedule advances without recompilation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    """Host-side schedule object (API parity with the reference class)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+        from deepspeed_tpu.utils.logging import log_dist
+
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        import math
+
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+
+
+def pld_theta(step, theta: float, gamma: float):
+    """Jittable theta(t) — the same curve, as a traced scalar."""
+    return (1.0 - theta) * jnp.exp(-gamma * step.astype(jnp.float32)) + theta

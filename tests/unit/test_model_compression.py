@@ -1,0 +1,220 @@
+"""PLD + eigenvalue probe + compression-aware training (reference
+``runtime/progressive_layer_drop.py``, ``runtime/eigenvalue.py``,
+``deepspeed/compression/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.topology import reset_topology
+from deepspeed_tpu.compression import (
+    CompressionScheduler,
+    fake_quantize,
+    head_prune_mask,
+    magnitude_prune_mask,
+    row_prune_mask,
+)
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.runtime.progressive_layer_drop import (
+    ProgressiveLayerDrop,
+    pld_theta,
+)
+
+VOCAB = 256
+
+
+# ------------------------------------------------------------------ PLD
+def test_pld_schedule_matches_reference_curve():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    pld.update_state(0)
+    assert pld.get_theta() == pytest.approx(1.0)
+    pld.update_state(1000)
+    # (1-0.5)*exp(-10)+0.5 ~ 0.50002
+    assert pld.get_theta() == pytest.approx(0.5, abs=1e-3)
+    # jittable twin agrees
+    t = float(pld_theta(jnp.int32(1000), 0.5, 0.01))
+    assert t == pytest.approx(pld.get_theta(), rel=1e-5)
+    assert pld.get_state()["progressive_layer_drop"] is True
+
+
+def test_pld_training_runs_and_drops():
+    reset_topology()
+    cfg = {
+        "train_micro_batch_size_per_device": 2,
+        "steps_per_print": 0,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.05},
+        "mesh": {"data": 8},
+        "seed": 7,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(llama.LlamaConfig.tiny(VOCAB), ctx=ctx),
+        config=cfg, seed=11,
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, VOCAB, (16, 16), dtype=np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    with pytest.raises(NotImplementedError):
+        engine.backward(batch)
+
+
+# ------------------------------------------------------------------ eigenvalue
+def test_eigenvalue_quadratic_form():
+    """On a pure quadratic loss the Hessian is known: L = sum(a * w^2)
+    has top eigenvalue 2*max(a) per block."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    a = jnp.stack([jnp.array([1.0, 3.0]), jnp.array([5.0, 2.0])])  # [2 blocks, 2]
+
+    def loss_fn(params, batch, rng=None):
+        return jnp.sum(a * jnp.square(params["layers"]))
+
+    params = {"layers": jnp.ones((2, 2))}
+    probe = Eigenvalue(max_iter=50, tol=1e-4, layer_num=2)
+    vals = probe.compute_eigenvalue(loss_fn, params, {}, jax.random.PRNGKey(0))
+    # raw eigenvalues 6 and 10 -> post-processed to [0.6, 1.0]
+    assert vals == pytest.approx([0.6, 1.0], rel=1e-2)
+
+
+def test_eigenvalue_engine_probe():
+    reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(llama.LlamaConfig.tiny(VOCAB), ctx=ctx),
+        config={
+            "train_micro_batch_size_per_device": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "eigenvalue": {"enabled": True, "max_iter": 3, "tol": 1e-1},
+            "mesh": {"data": 8},
+        }, seed=11,
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, VOCAB, (16, 16), dtype=np.int32)}
+    vals = engine.compute_eigenvalue(batch)
+    assert len(vals) == 2  # tiny llama has 2 layers
+    assert all(0.0 <= v <= 1.0 for v in vals)
+
+
+# ------------------------------------------------------------------ compression
+def test_fake_quantize_ste():
+    w = jnp.linspace(-1, 1, 32).reshape(4, 8)
+    q = fake_quantize(w, bits=4)
+    # quantized to <= 2^4 distinct levels, and gradient is identity (STE)
+    assert len(np.unique(np.asarray(q))) <= 16
+    g = jax.grad(lambda w: jnp.sum(fake_quantize(w, 4)))(w)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+    # 16-bit quantization is near-lossless
+    np.testing.assert_allclose(np.asarray(fake_quantize(w, 16)),
+                               np.asarray(w), atol=1e-3)
+
+
+def test_prune_masks():
+    w = jnp.arange(1.0, 17.0).reshape(4, 4)
+    m = magnitude_prune_mask(w, ratio=0.5)
+    assert float(m.sum()) <= 8
+    rm = row_prune_mask(w, ratio=0.5)  # [1, out]
+    assert rm.shape == (1, 4) and float(rm.sum()) == 2
+    hm = head_prune_mask(w, ratio=0.5, num_heads=2)
+    assert hm.shape == (4, 1) and float(hm.sum()) == 2
+
+
+def test_apply_to_params_stacked_leaves_and_grad_masking():
+    """Stacked [L, in, out] leaves must be handled per layer, and pruning
+    masks must gate gradients (reference module-wrapper semantics)."""
+    sched = CompressionScheduler({
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "sp": {"params": {"dense_ratio": 0.5}, "modules": ["w"]}},
+        },
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "hp": {"params": {"dense_ratio": 0.5}, "modules": ["wo"]}},
+        },
+    }, num_heads=2)
+    params = {
+        "layers": {
+            "w": jnp.arange(1.0, 33.0).reshape(2, 4, 4),   # stacked 2 layers
+            "wo": jnp.arange(1.0, 33.0).reshape(2, 4, 4),  # [L, H*Dh, out]
+        }
+    }
+    out = sched.apply_to_params(params, jnp.int32(1))
+    w = np.asarray(out["layers"]["w"])
+    # each LAYER loses ~half its entries (per-layer quantile, not global)
+    for layer in range(2):
+        assert 6 <= (w[layer] == 0).sum() <= 10
+    wo = np.asarray(out["layers"]["wo"])
+    for layer in range(2):  # one of two heads (rows 0-1 vs 2-3) zeroed
+        assert (wo[layer][:2] == 0).all() or (wo[layer][2:] == 0).all()
+
+    # gradients at pruned coordinates must be zero when the mask is applied
+    # inside the tape
+    def loss(p):
+        cp = sched.apply_to_params(p, jnp.int32(1))
+        return jnp.sum(jnp.square(cp["layers"]["w"]))
+
+    g = np.asarray(jax.grad(loss)(params)["layers"]["w"])
+    assert ((w == 0) <= (g == 0)).all()
+
+
+def test_scheduler_bits_annealing():
+    sched = CompressionScheduler({
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 10},
+            "different_groups": {
+                "wq1": {"params": {"start_bits": 8, "target_bits": 4,
+                                   "quantization_period": 5},
+                        "modules": ["w_gate"]},
+            },
+        },
+    })
+    g = sched.config.methods["weight_quantization"].groups[0]
+    bits = [float(sched.current_bits(g.params, "weight_quantization",
+                                     jnp.int32(s))) for s in (0, 12, 17, 40)]
+    assert bits == [8.0, 8.0, 7.0, 4.0]
+    assert float(sched.is_active("weight_quantization", jnp.int32(5))) == 0.0
+    assert float(sched.is_active("weight_quantization", jnp.int32(10))) == 1.0
+
+
+def test_qat_training_end_to_end():
+    reset_topology()
+    cfg = {
+        "train_micro_batch_size_per_device": 2,
+        "steps_per_print": 0,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 2},
+                "different_groups": {
+                    "all_mlp": {"params": {"start_bits": 8, "target_bits": 8},
+                                "modules": ["w_gate", "w_up", "w_down"]},
+                },
+            },
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 3},
+                "different_groups": {
+                    "sp": {"params": {"dense_ratio": 0.8},
+                           "modules": ["w_up"]},
+                },
+            },
+        },
+        "mesh": {"data": 8},
+        "seed": 7,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(llama.LlamaConfig.tiny(VOCAB), ctx=ctx),
+        config=cfg, seed=11,
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, VOCAB, (16, 16), dtype=np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
