@@ -37,9 +37,22 @@ def _path_str(path) -> str:
 
 
 def _match(patterns, path: str) -> bool:
+    """Pattern semantics: regex when the pattern compiles, else a glob
+    (reference configs use globs like ``*.attention`` — those are invalid
+    regexes, so they fall through to ``fnmatch``); a plain name is a regex
+    substring search, matching the reference's substring behavior."""
+    import fnmatch
+
     for p in patterns:
-        if p == "*" or re.search(p, path):
+        if p == "*":
             return True
+        try:
+            if re.search(p, path):
+                return True
+        except re.error:
+            # reference module names use "." separators; our paths use "/"
+            if fnmatch.fnmatch(path, p.replace(".", "/")):
+                return True
     return False
 
 

@@ -39,6 +39,8 @@ class InferenceEngine:
         params: Any = None,
         checkpoint: str | None = None,
         seed: int = 0,
+        quantize_bits: int = 0,
+        quantize_block: int = 256,
     ):
         if topology_initialized():
             self.topo = get_topology()
@@ -76,10 +78,23 @@ class InferenceEngine:
         )
         if checkpoint is not None:
             self.load_checkpoint(checkpoint)
+        # weight-only quantization (reference inference/quantization/ WOQ):
+        # >=2D weights stored int8/int4 blockwise, dequantized just in time
+        # per scanned layer (models call ops.quantizer.maybe_dequantize)
+        self.quantize_bits = int(quantize_bits)
+        if self.quantize_bits:
+            from deepspeed_tpu.ops.quantizer import quantize_params
+
+            self.params = jax.jit(
+                lambda p: quantize_params(p, bits=self.quantize_bits,
+                                          block=quantize_block)
+            )(self.params)
         self._gen_cache: dict = {}
         log_dist(
             f"InferenceEngine: model={self.spec.name} tp={self.topo.size('tensor')} "
-            f"dtype={jnp.dtype(dtype).name}", ranks=[0],
+            f"dtype={jnp.dtype(dtype).name}"
+            + (f" woq=int{self.quantize_bits}" if self.quantize_bits else ""),
+            ranks=[0],
         )
 
     def load_checkpoint(self, ckpt_dir: str) -> None:
@@ -152,8 +167,11 @@ class InferenceEngine:
         return np.concatenate([input_ids, np.asarray(toks)], axis=1)
 
     def forward(self, input_ids):
-        """Plain logits forward (reference ``engine.forward:557``)."""
-        return self.spec.forward_fn(self.params, jnp.asarray(input_ids))
+        """Plain logits forward (reference ``engine.forward:557``); jitted —
+        sharding constraints inside the model require a compiled context."""
+        if not hasattr(self, "_fwd_jit"):
+            self._fwd_jit = jax.jit(self.spec.forward_fn)
+        return self._fwd_jit(self.params, jnp.asarray(input_ids))
 
     __call__ = forward
 
@@ -164,14 +182,23 @@ def init_inference(model, config: dict | None = None, **kwargs):
     config.update(kwargs)
     tp = config.get("tensor_parallel", {})
     mp_size = tp.get("tp_size", config.get("mp_size", 1)) if isinstance(tp, dict) else int(tp)
+    dtype_str = str(config.get("dtype", "bf16")).replace("torch.", "").replace(
+        "float16", "fp16")
     dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16, "fp32": jnp.float32}.get(
-        str(config.get("dtype", "bf16")).replace("torch.", "").replace("float16", "fp16"),
-        jnp.bfloat16,
-    )
+        dtype_str, jnp.bfloat16)
+    # reference WOQ knobs: dtype=torch.int8 or quant: {weight: {num_bits}}
+    bits = 0
+    if dtype_str in ("int8", "qint8"):
+        bits = 8
+    quant = config.get("quant")
+    if isinstance(quant, dict) and quant.get("enabled", True):
+        bits = int((quant.get("weight") or {}).get("num_bits", bits or 8))
     return InferenceEngine(
         model,
         mp_size=mp_size,
         dtype=dtype,
         params=config.get("params"),
         checkpoint=config.get("checkpoint"),
+        quantize_bits=int(config.get("quantize_bits", bits)),
+        quantize_block=int(config.get("quantize_block", 256)),
     )

@@ -129,7 +129,7 @@ class RaggedInferenceEngine:
 
     def __init__(self, model, ragged_config: RaggedConfig | None = None,
                  dtype=jnp.bfloat16, params: Any = None, seed: int = 0,
-                 eos_token_id: int | None = None):
+                 eos_token_id: int | None = None, quantize_bits: int = 0):
         self.cfg = ragged_config or RaggedConfig()
         self.ctx = ShardCtx()
         self.spec: ModelSpec = model(self.ctx) if callable(model) else model
@@ -144,6 +144,14 @@ class RaggedInferenceEngine:
             lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
             params,
         )
+        if quantize_bits:
+            # weight-only quantization over the paged-KV engine (reference
+            # inference/quantization WOQ composed with the v2 ragged engine)
+            from deepspeed_tpu.ops.quantizer import quantize_params
+
+            self.params = jax.jit(
+                lambda p: quantize_params(p, bits=int(quantize_bits))
+            )(self.params)
         self.cache = self.spec.init_paged_cache_fn(
             self.cfg.num_blocks, self.cfg.block_size, dtype
         )

@@ -227,6 +227,9 @@ class ModelSpec:
     # 1F1B pipeline decomposition (parallel/pipeline_1f1b.py): the tuple
     # (stage0_fn, block_fn, last_fn, split_fn, merge_fn) itself
     pipeline_parts: Any = None
+    # whether loss_fn honors batch["pld_theta"] (progressive layer drop);
+    # the engine refuses to enable PLD on models that would silently ignore it
+    supports_pld: bool = False
 
 
 def causal_lm_loss(

@@ -97,6 +97,9 @@ def layernorm(x, g, b, eps):
 
 
 def _block(cfg: GPT2Config, ctx: ShardCtx, attn_impl: str, x, lp):
+    from deepspeed_tpu.ops.quantizer import dequantize_layer
+
+    lp = dequantize_layer(lp, x.dtype)  # WOQ no-op on dense weights
     b, s, d = x.shape
     h = layernorm(x, lp["ln1_g"], lp["ln1_b"], cfg.layer_norm_eps)
     q = (h @ lp["wq"] + lp["bq"]).reshape(b, s, cfg.num_heads, cfg.hd)
@@ -113,7 +116,8 @@ def _block(cfg: GPT2Config, ctx: ShardCtx, attn_impl: str, x, lp):
 
 
 def forward(cfg: GPT2Config, params, input_ids, ctx: ShardCtx | None = None,
-            attn_impl: str = "auto", remat: bool = False, remat_policy=None):
+            attn_impl: str = "auto", remat: bool = False, remat_policy=None,
+            pld_theta=None, pld_rng=None):
     ctx = ctx or ShardCtx()
     b, s = input_ids.shape
     x = params["wte"][input_ids] + params["wpe"][:s][None, :, :]
@@ -122,7 +126,8 @@ def forward(cfg: GPT2Config, params, input_ids, ctx: ShardCtx | None = None,
     layer = partial(_block, cfg, ctx, attn_impl)
     if remat:
         layer = jax.checkpoint(layer, policy=remat_policy)
-    x = ctx.layer_stack(layer, params["layers"], x)
+    x = ctx.layer_stack(layer, params["layers"], x,
+                        pld_theta=pld_theta, pld_rng=pld_rng)
     x = layernorm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
     logits = x @ params["wte"].T.astype(x.dtype)  # tied head
     return ctx.constrain(logits, "batch", "seq", "vocab_act")
@@ -147,8 +152,10 @@ def build(cfg: GPT2Config, ctx: ShardCtx | None = None, attn_impl: str = "auto",
                   remat=remat, remat_policy=remat_policy)
 
     def loss_fn(params, batch, rng=None):
-        del rng
-        logits = fwd(params, batch["input_ids"])
+        pld = batch.get("pld_theta")
+        if pld is not None and rng is None:
+            raise ValueError("progressive layer drop needs the loss rng")
+        logits = fwd(params, batch["input_ids"], pld_theta=pld, pld_rng=rng)
         return causal_lm_loss(logits, batch["input_ids"], batch.get("labels"))
 
     return ModelSpec(
@@ -161,4 +168,5 @@ def build(cfg: GPT2Config, ctx: ShardCtx | None = None, attn_impl: str = "auto",
         logical_dim_units={"heads": cfg.num_heads},
         num_params=num_params(cfg),
         flops_per_token=partial(flops_per_token, cfg),
+        supports_pld=True,
     )

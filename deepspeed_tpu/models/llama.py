@@ -110,8 +110,12 @@ def rmsnorm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
 
 
+from deepspeed_tpu.ops.quantizer import dequantize_layer as _dq_layer  # noqa: E402
+
+
 def _decoder_layer(cfg: LlamaConfig, ctx: ShardCtx, attn_impl: str,
                    x: jnp.ndarray, lp: dict, positions: jnp.ndarray | None = None) -> jnp.ndarray:
+    lp = _dq_layer(lp, x.dtype)
     b, s, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     if positions is None:
@@ -166,7 +170,11 @@ def hidden_states(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
 
 
 def lm_head(cfg: LlamaConfig, params: dict) -> jnp.ndarray:
-    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    if cfg.tie_embeddings:
+        return params["embed"].T
+    from deepspeed_tpu.ops.quantizer import maybe_dequantize
+
+    return maybe_dequantize(params["lm_head"], jnp.float32)
 
 
 def forward(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
@@ -195,6 +203,7 @@ def _cached_layer(cfg: LlamaConfig, ctx: ShardCtx, x, lp, k_cache, v_cache,
                   start_pos, max_len: int):
     """Decode/prefill layer: append new KV at ``start_pos``, attend over the
     cache prefix with absolute-position causal masking."""
+    lp = _dq_layer(lp, x.dtype)
     b, t, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
 
@@ -244,7 +253,7 @@ def decode_forward(cfg: LlamaConfig, params, tokens, cache, start_pos,
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = lm_head(cfg, params)
     logits = x @ head.astype(x.dtype)
     return logits, {"k": new_k, "v": new_v}
 
@@ -267,6 +276,7 @@ def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots, block_table
     scattered into the block pool *before* attention, so intra-chunk causal
     attention falls out of the position mask with no special casing.
     """
+    lp = _dq_layer(lp, x.dtype)
     t_tokens, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     bs = kc.shape[1]
@@ -331,7 +341,7 @@ def ragged_forward(cfg: LlamaConfig, params, tokens, slots, positions,
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = lm_head(cfg, params)
     logits = x @ head.astype(x.dtype)
     return logits, {"k": new_k, "v": new_v}
 
@@ -434,4 +444,5 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         init_paged_cache_fn=partial(init_paged_cache, cfg),
         ragged_forward_fn=partial(ragged_forward, cfg),
         pipeline_parts=pipeline_parts(cfg, ctx=ctx, attn_impl=attn_impl),
+        supports_pld=True,
     )

@@ -110,6 +110,9 @@ PARAM_LOGICAL_AXES = {
 
 def _layer(cfg: MixtralConfig, moe_cfg: MoEConfig, ctx: ShardCtx, attn_impl: str,
            train: bool, x, lp, positions, rng):
+    from deepspeed_tpu.ops.quantizer import dequantize_layer
+
+    lp = dequantize_layer(lp, x.dtype)  # WOQ no-op on dense weights
     b, s, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
 
@@ -154,7 +157,9 @@ def forward(cfg: MixtralConfig, params, input_ids, ctx: ShardCtx | None = None,
         (params["layers"], jnp.arange(cfg.num_layers)),
     )
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = x @ params["lm_head"].astype(x.dtype)
+    from deepspeed_tpu.ops.quantizer import maybe_dequantize
+
+    logits = x @ maybe_dequantize(params["lm_head"], x.dtype).astype(x.dtype)
     logits = ctx.constrain(logits, "batch", "seq", "vocab_act")
     if return_aux:
         return logits, aux_sum / cfg.num_layers

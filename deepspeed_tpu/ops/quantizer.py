@@ -76,3 +76,89 @@ def quantization_error(x: jnp.ndarray, bits: int = 8, block: int = 256) -> jnp.n
     """Residual for error-feedback compression (1-bit Adam family,
     ``runtime/comm/compressed.py`` semantics)."""
     return x - quantize_dequantize(x, bits=bits, block=block)
+
+
+# ------------------------------------------------------------------ WOQ params
+class QuantizedWeight:
+    """A weight stored quantized in a param pytree (weight-only-quant
+    inference, reference ``inference/quantization/`` WOQ layers).
+
+    Registered pytree node: (values, scales) are children so the tree flows
+    through jit/scan/sharding; (shape, bits, block) are static aux data —
+    unlike :class:`QuantizedTensor` (a NamedTuple whose shape ints would be
+    traced), reshapes stay static under jit. Stacked layer weights keep a
+    leading layer dim on the children; ``shape`` is the PER-LAYER shape, so
+    a ``lax.scan`` slice of the tree dequantizes directly.
+    """
+
+    def __init__(self, values, scales, shape, bits, block):
+        self.values = values
+        self.scales = scales
+        self.shape = tuple(shape)
+        self.bits = int(bits)
+        self.block = int(block)
+
+    def tree_flatten(self):
+        return (self.values, self.scales), (self.shape, self.bits, self.block)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedWeight,
+    lambda qw: qw.tree_flatten(),
+    QuantizedWeight.tree_unflatten,
+)
+
+
+def maybe_dequantize(w, dtype):
+    """Identity on arrays; dequantize on :class:`QuantizedWeight` — model
+    code calls this at the point of use so dequantization happens just in
+    time, per scanned layer slice (transient, fused by XLA)."""
+    if not isinstance(w, QuantizedWeight):
+        return w
+    qt = QuantizedTensor(values=w.values, scales=w.scales, shape=w.shape,
+                         bits=w.bits, block=w.block)
+    return dequantize(qt, dtype)
+
+
+def dequantize_layer(lp: dict, dtype) -> dict:
+    """Just-in-time dequantization of a layer's weight dict (no-op on plain
+    arrays); model layer fns call this first, so WOQ dense copies are
+    per-scanned-layer transients."""
+    return {k: maybe_dequantize(v, dtype) for k, v in lp.items()}
+
+
+def quantize_params(params, bits: int = 8, block: int = 256,
+                    skip: tuple = ("embed",), stacked_key: str = "layers"):
+    """Quantize the matrix leaves of a param pytree into
+    :class:`QuantizedWeight` (weight-only quantization).
+
+    Leaves under ``stacked_key`` carry a leading layer dim: matrices there
+    are ndim >= 3 and quantize per layer (so a decoder ``lax.scan`` slices
+    the tree naturally); ndim-2 leaves there are stacked *vectors* (norms)
+    and stay dense. Outside the stacked subtree, plain ndim-2 matrices
+    quantize whole. Leaves whose path contains a name in ``skip`` stay
+    dense (embedding gathers want a plain array)."""
+
+    def q(path, leaf):
+        names = {str(getattr(k, "key", "")) for k in path}
+        stacked = stacked_key in names
+        min_ndim = 3 if stacked else 2
+        if (not hasattr(leaf, "ndim") or leaf.ndim < min_ndim
+                or not jnp.issubdtype(leaf.dtype, jnp.floating)
+                or names & set(skip)):
+            return leaf
+        if stacked:  # per-layer blocks
+            def qvs(w):
+                qt = quantize(w, bits=bits, block=block)
+                return qt.values, qt.scales
+
+            vals, scales = jax.vmap(qvs)(leaf)
+            return QuantizedWeight(vals, scales, leaf.shape[1:], bits, block)
+        qt = quantize(leaf, bits=bits, block=block)
+        return QuantizedWeight(qt.values, qt.scales, qt.shape, bits, block)
+
+    return jax.tree_util.tree_map_with_path(q, params)

@@ -269,6 +269,20 @@ class Engine:
 
         self.monitor = MonitorMaster(config.monitor)
 
+        if (config.progressive_layer_drop.enabled
+                and not self.model_spec.supports_pld):
+            raise ValueError(
+                f"model {self.model_spec.name!r} does not honor "
+                "progressive_layer_drop (its loss_fn ignores pld_theta); "
+                "enabling it would silently train without PLD")
+        if (config.pipeline.schedule == "1f1b" and topo.size("pipeline") > 1
+                and (config.progressive_layer_drop.enabled
+                     or config.compression_training)):
+            raise ValueError(
+                "pipeline.schedule='1f1b' bypasses the GAS grad path that "
+                "applies progressive_layer_drop / compression_training; "
+                "these combinations would silently no-op")
+
         # compression-aware training (reference deepspeed/compression/):
         # scheduled QAT + pruning applied to the compute-cast params
         self._compression = None

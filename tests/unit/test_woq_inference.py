@@ -1,0 +1,143 @@
+"""Weight-only quantized inference (reference ``inference/quantization/``
+WOQ layers + ``init_inference`` int8 path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.topology import reset_topology
+from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.ops.quantizer import (
+    QuantizedWeight,
+    maybe_dequantize,
+    quantize_params,
+)
+
+VOCAB = 256
+
+
+def _params():
+    cfg = llama.LlamaConfig.tiny(VOCAB)
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def test_quantize_params_structure():
+    cfg, params = _params()
+    qp = quantize_params(params, bits=8)
+    # embedding and norms stay dense
+    assert isinstance(qp["embed"], jnp.ndarray)
+    assert isinstance(qp["final_norm"], jnp.ndarray)
+    # stacked layer weights quantize per layer (leading layer dim kept)
+    wq = qp["layers"]["wq"]
+    assert isinstance(wq, QuantizedWeight)
+    assert wq.values.shape[0] == cfg.num_layers
+    assert wq.shape == tuple(params["layers"]["wq"].shape[1:])
+    # lax.scan-style slice of the tree dequantizes to the per-layer weight
+    sliced = jax.tree_util.tree_map(lambda x: x[0], wq)
+    deq = maybe_dequantize(sliced, jnp.float32)
+    ref = np.asarray(params["layers"]["wq"][0])
+    assert deq.shape == ref.shape
+    assert np.abs(np.asarray(deq) - ref).max() < 0.01  # int8 block error
+
+
+def test_quantized_tree_is_smaller():
+    _, params = _params()
+    qp = quantize_params(params, bits=8)
+
+    def nbytes(t):
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(t)
+                   if hasattr(x, "dtype"))
+
+    dense = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), params)
+    # layer weights dominate; int8 + f32/block scales < bf16
+    assert nbytes(qp) < 0.8 * nbytes(dense)
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_woq_logits_close_and_generate(bits):
+    reset_topology()
+    cfg = llama.LlamaConfig.tiny(VOCAB)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    dense = InferenceEngine(lambda ctx: llama.build(cfg, ctx=ctx),
+                            params=params, dtype=jnp.float32)
+    reset_topology()
+    woq = InferenceEngine(lambda ctx: llama.build(cfg, ctx=ctx),
+                          params=params, dtype=jnp.float32,
+                          quantize_bits=bits)
+    ids = np.arange(16, dtype=np.int32)[None, :] % VOCAB
+    l_d = np.asarray(dense.forward(ids))
+    l_q = np.asarray(woq.forward(ids))
+    # int8 tracks closely; int4 more loosely — argmax agreement is the bar
+    agree = (l_d.argmax(-1) == l_q.argmax(-1)).mean()
+    assert agree >= (0.9 if bits == 8 else 0.6), agree
+    out = woq.generate(ids, max_new_tokens=8)
+    assert out.shape == (1, 24)
+
+
+def test_init_inference_int8_config():
+    reset_topology()
+    cfg = llama.LlamaConfig.tiny(VOCAB)
+    eng = init_inference(
+        lambda ctx: llama.build(cfg, ctx=ctx),
+        config={"dtype": "torch.int8",
+                "params": llama.init_params(cfg, jax.random.PRNGKey(0))})
+    assert eng.quantize_bits == 8
+    eng2 = init_inference(
+        lambda ctx: llama.build(cfg, ctx=ctx),
+        config={"quant": {"weight": {"num_bits": 4}},
+                "params": llama.init_params(cfg, jax.random.PRNGKey(0))})
+    assert eng2.quantize_bits == 4
+
+
+def test_woq_gpt2_and_mixtral():
+    """WOQ must work for every model family, not just llama."""
+    from deepspeed_tpu.models import gpt2, mixtral
+
+    from deepspeed_tpu.ops.quantizer import quantize_params as qp
+
+    reset_topology()
+    g = gpt2.GPT2Config(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64)
+    gspec = gpt2.build(g)
+    gparams = qp(gpt2.init_params(g, jax.random.PRNGKey(0)), bits=8,
+                 skip=("wte", "wpe"))
+    l = np.asarray(jax.jit(gspec.forward_fn)(
+        gparams, np.arange(8, dtype=np.int32)[None, :]))
+    assert np.isfinite(l).all()
+    reset_topology()
+    m = mixtral.MixtralConfig.tiny(VOCAB)
+    params = mixtral.init_params(m, jax.random.PRNGKey(0))
+    spec = mixtral.build(m)
+    logits, = [np.asarray(jax.jit(spec.forward_fn)(
+        jax.jit(lambda p: qp(p, bits=8))(params),
+        np.arange(8, dtype=np.int32)[None, :]))]
+    assert np.isfinite(logits).all()
+
+
+def test_glob_module_patterns():
+    from deepspeed_tpu.compression.scheduler import _match
+
+    assert _match(["*.attention"], "layers/attention")   # glob fallback
+    assert _match(["w_gate"], "layers/w_gate")           # substring regex
+    assert not _match(["w_gate"], "layers/wq")
+
+
+def test_ragged_engine_woq():
+    from deepspeed_tpu.inference.ragged import RaggedConfig, RaggedInferenceEngine
+
+    reset_topology()
+    cfg = llama.LlamaConfig.tiny(VOCAB)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = RaggedInferenceEngine(
+        lambda ctx: llama.build(cfg, ctx=ctx),
+        RaggedConfig(max_seqs=4, num_blocks=64, block_size=16,
+                     max_tokens_per_step=32),
+        params=params, dtype=jnp.float32, quantize_bits=8)
+    eng.put("a", list(range(10)), max_new_tokens=4)
+    eng.put("b", list(range(5)), max_new_tokens=4)
+    out = eng.generate_all()
+    assert len(out["a"]) == 4 and len(out["b"]) == 4
+    assert all(0 <= t < VOCAB for t in out["a"] + out["b"])
