@@ -82,13 +82,9 @@ class InferenceEngine:
         # >=2D weights stored int8/int4 blockwise, dequantized just in time
         # per scanned layer (models call ops.quantizer.maybe_dequantize)
         self.quantize_bits = int(quantize_bits)
+        self._quantize_block = quantize_block
         if self.quantize_bits:
-            from deepspeed_tpu.ops.quantizer import quantize_params
-
-            self.params = jax.jit(
-                lambda p: quantize_params(p, bits=self.quantize_bits,
-                                          block=quantize_block)
-            )(self.params)
+            self.params = self._quantize(self.params)
         self._gen_cache: dict = {}
         log_dist(
             f"InferenceEngine: model={self.spec.name} tp={self.topo.size('tensor')} "
@@ -97,8 +93,21 @@ class InferenceEngine:
             ranks=[0],
         )
 
+    def _quantize(self, params):
+        from deepspeed_tpu.ops.quantizer import quantize_params
+
+        return jax.jit(
+            lambda p: quantize_params(p, bits=self.quantize_bits,
+                                      block=self._quantize_block,
+                                      skip=tuple(self.spec.woq_skip))
+        )(params)
+
     def load_checkpoint(self, ckpt_dir: str) -> None:
-        """Load params saved by ``Engine.save_checkpoint`` (universal layout)."""
+        """Load params saved by ``Engine.save_checkpoint`` (universal layout).
+
+        On a WOQ engine the checkpoint's dense weights load into a fresh
+        dense tree and are re-quantized (the live tree's leaves are int8
+        values + scales — dense arrays cannot be mapped onto it)."""
         import os
 
         from deepspeed_tpu.checkpoint import engine as ckpt
@@ -106,17 +115,29 @@ class InferenceEngine:
 
         from deepspeed_tpu.checkpoint import sharded
 
+        target = self.params
+        if getattr(self, "quantize_bits", 0):
+            target = jax.jit(
+                self.spec.init_fn, out_shardings=self.plan.param_shardings
+            )(jax.random.PRNGKey(0))
+            target = jax.tree_util.tree_map(
+                lambda x: x.astype(self.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, target)
+
         tag = ckpt.latest_tag(ckpt_dir)
         model_dir = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
         if sharded.is_sharded(model_dir, "model"):
             # fragments re-placed straight under the inference plan/dtype
-            self.params = sharded.load_sharded(self.params, model_dir, "model")
-            return
-        arrays = ser.load_arrays(os.path.join(model_dir, "model.npz"))
-        host = ser.arrays_to_tree(
-            jax.tree_util.tree_map(np.asarray, self.params), arrays
-        )
-        self.params = jax.device_put(host, self.plan.param_shardings)
+            loaded = sharded.load_sharded(target, model_dir, "model")
+        else:
+            arrays = ser.load_arrays(os.path.join(model_dir, "model.npz"))
+            host = ser.arrays_to_tree(
+                jax.tree_util.tree_map(np.asarray, target), arrays
+            )
+            loaded = jax.device_put(host, self.plan.param_shardings)
+        if getattr(self, "quantize_bits", 0):
+            loaded = self._quantize(loaded)
+        self.params = loaded
 
     # ------------------------------------------------------------------ generate
     def _build_generate(self, batch: int, prompt_len: int, max_new: int, sample: bool):

@@ -150,7 +150,8 @@ class RaggedInferenceEngine:
             from deepspeed_tpu.ops.quantizer import quantize_params
 
             self.params = jax.jit(
-                lambda p: quantize_params(p, bits=int(quantize_bits))
+                lambda p: quantize_params(p, bits=int(quantize_bits),
+                                          skip=tuple(self.spec.woq_skip))
             )(self.params)
         self.cache = self.spec.init_paged_cache_fn(
             self.cfg.num_blocks, self.cfg.block_size, dtype

@@ -230,6 +230,9 @@ class ModelSpec:
     # whether loss_fn honors batch["pld_theta"] (progressive layer drop);
     # the engine refuses to enable PLD on models that would silently ignore it
     supports_pld: bool = False
+    # param names kept dense under weight-only quantization (tables the model
+    # indexes rather than matmuls, e.g. embeddings)
+    woq_skip: tuple = ("embed",)
 
 
 def causal_lm_loss(

@@ -169,4 +169,5 @@ def build(cfg: GPT2Config, ctx: ShardCtx | None = None, attn_impl: str = "auto",
         num_params=num_params(cfg),
         flops_per_token=partial(flops_per_token, cfg),
         supports_pld=True,
+        woq_skip=("wte", "wpe"),
     )

@@ -294,26 +294,11 @@ def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots, block_table
     kc = kc.at[blk, off].set(kk.astype(kc.dtype))
     vc = vc.at[blk, off].set(vv.astype(vc.dtype))
 
-    # per-token gather of its sequence's blocked context
-    tables = block_tables[slots]  # [T, max_blocks]
-    ctx_k = kc[tables].reshape(t_tokens, -1, hkv, hd)
-    ctx_v = vc[tables].reshape(t_tokens, -1, hkv, hd)
-    from deepspeed_tpu.ops.attention import repeat_kv
+    # paged attention over the blocked pool: Pallas block-table kernel on
+    # TPU, padded-gather XLA fallback (ops/attention.paged_attention)
+    from deepspeed_tpu.ops.attention import paged_attention
 
-    rep = hq // hkv
-    ctx_k = repeat_kv(ctx_k, rep)
-    ctx_v = repeat_kv(ctx_v, rep)
-
-    k_pos = jnp.arange(ctx_k.shape[1])
-    bias = jnp.where(k_pos[None, :] <= positions[:, None], 0.0, -1e30)  # [T, ctx]
-    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
-    scores = (
-        jnp.einsum("thd,tchd->thc", (q * scale).astype(jnp.float32),
-                   ctx_k.astype(jnp.float32))
-        + bias[:, None, :]
-    )
-    p = jax.nn.softmax(scores, axis=-1)
-    o = jnp.einsum("thc,tchd->thd", p, ctx_v.astype(jnp.float32)).astype(x.dtype)
+    o = paged_attention(q, kc, vc, slots, positions, block_tables).astype(x.dtype)
     x = x + o.reshape(t_tokens, hq * hd) @ lp["wo"]
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -89,6 +89,43 @@ def attention(
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
+def paged_attention(q, k_pool, v_pool, slots, positions, block_tables,
+                    scale: float | None = None, impl: str = "auto"):
+    """Ragged paged-KV attention: [T, Hq, D] tokens over the blocked pool
+    (reference ``inference/v2/kernels/ragged_ops`` blocked flash attention).
+
+    impl="pallas": stream blocks through VMEM via the block table (no padded
+    gather); impl="xla": gather the padded context (fallback / CPU tests).
+    """
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "xla"
+    if impl == "pallas":
+        try:
+            from deepspeed_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention,
+            )
+
+            return paged_decode_attention(q, k_pool, v_pool, slots, positions,
+                                          block_tables, scale=scale)
+        except (ImportError, NotImplementedError):
+            impl = "xla"
+    if impl != "xla":
+        raise ValueError(f"unknown paged attention impl {impl!r}")
+    t_tokens, hq, d = q.shape
+    hkv = k_pool.shape[2]
+    tables = block_tables[slots]                       # [T, MB]
+    ctx_k = repeat_kv(k_pool[tables].reshape(t_tokens, -1, hkv, d), hq // hkv)
+    ctx_v = repeat_kv(v_pool[tables].reshape(t_tokens, -1, hkv, d), hq // hkv)
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.float32(d))
+    k_pos = jnp.arange(ctx_k.shape[1])
+    bias = jnp.where(k_pos[None, :] <= positions[:, None], 0.0, -1e30)
+    scores = (jnp.einsum("thd,tchd->thc", (q * scale).astype(jnp.float32),
+                         ctx_k.astype(jnp.float32)) + bias[:, None, :])
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("thc,tchd->thd", p, ctx_v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
 @functools.partial(jax.jit, static_argnums=(3,))
 def apply_rope(q, k, positions, theta: float = 10000.0):
     """Rotary position embedding (reference: ``apply_rotary_pos_emb`` kernels,

@@ -1,0 +1,125 @@
+"""Pallas paged flash-decode attention over the blocked KV pool.
+
+Role parity with the reference's ragged kernels
+(``inference/v2/kernels/ragged_ops/`` blocked flash attention +
+``ragged/csrc`` blocked-KV layout): each ragged token reads its sequence's
+KV directly from the block pool through the block table — no gather of the
+full padded context (the XLA fallback in ``models/llama.ragged_forward``
+materializes ``[T, max_blocks*block, H, D]``; this kernel streams one block
+at a time through VMEM with online-softmax accumulation).
+
+Mechanism: ``PrefetchScalarGridSpec`` — the block table and slot/position
+vectors are scalar-prefetch operands, so the KV BlockSpec index map resolves
+``pool_block = block_tables[slots[t], j]`` *before* the kernel body runs and
+the DMA fetches exactly that block (the TPU paged-attention idiom). Blocks
+past the token's position are predicated off with ``pl.when``.
+
+Inference-only (no VJP): the ragged engine never differentiates through
+decode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30
+
+
+def _kernel(slots_ref, pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+            acc, m_sc, l_sc, *, bs: int, rep: int, scale: float):
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    pos = pos_ref[t]
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    @pl.when(j * bs <= pos)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
+        k = k_ref[0].astype(jnp.float32)                  # [BS, Hkv, D]
+        v = v_ref[0].astype(jnp.float32)
+        hq, d = q.shape
+        hkv = k.shape[1]
+        qg = q.reshape(hkv, rep, d)
+        # scores[g, r, k] over this block's keys
+        s = jax.lax.dot_general(
+            qg, k.transpose(1, 2, 0),                     # [Hkv, D, BS]
+            (((2,), (1,)), ((0,), (0,))),                 # contract D, batch g
+        )                                                 # [Hkv, rep, BS]
+        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bs), 2)
+        s = jnp.where(kpos <= pos, s, _NEG_INF)
+        s = s.reshape(hq, bs)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)        # [Hq, 1]
+        m_prev = m_sc[:, :1]
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)                            # [Hq, BS]
+        corr = jnp.exp(m_prev - m_new)                    # [Hq, 1]
+        l_sc[:, :1] = l_sc[:, :1] * corr + jnp.sum(p, -1, keepdims=True)
+        m_sc[:, :1] = m_new
+        pg = p.reshape(hkv, rep, bs)
+        pv = jax.lax.dot_general(
+            pg, v.transpose(1, 0, 2),                     # [Hkv, BS, D]
+            (((2,), (1,)), ((0,), (0,))),                 # [Hkv, rep, D]
+        ).reshape(hq, d)
+        acc[:] = acc[:] * corr + pv
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        o_ref[0] = (acc[:] / jnp.maximum(l_sc[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, slots, positions, block_tables,
+                           scale: float | None = None):
+    """[T, Hq, D] ragged tokens -> [T, Hq, D] attention outputs.
+
+    ``k_pool``/``v_pool``: [NB, BS, Hkv, D]; ``block_tables``:
+    [max_seqs+1, MB] mapping (slot, block-ordinal) -> pool block id. Exact
+    vs the dense-gather path (same position masking).
+    """
+    if pltpu is None:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    t_tokens, hq, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    rep = hq // hkv
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(t_tokens, mb),
+        in_specs=[
+            pl.BlockSpec((1, hq, d), lambda t, j, sl, po, bt: (t, 0, 0)),
+            pl.BlockSpec((1, bs, hkv, d),
+                         lambda t, j, sl, po, bt: (bt[sl[t], j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, hkv, d),
+                         lambda t, j, sl, po, bt: (bt[sl[t], j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hq, d), lambda t, j, sl, po, bt: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hq, d), jnp.float32),
+            pltpu.VMEM((hq, 128), jnp.float32),
+            pltpu.VMEM((hq, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_kernel, bs=bs, rep=rep, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((t_tokens, hq, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=jax.default_backend() != "tpu",
+    )(slots.astype(jnp.int32), positions.astype(jnp.int32),
+      block_tables.astype(jnp.int32), q, k_pool, v_pool)

@@ -117,6 +117,54 @@ def test_woq_gpt2_and_mixtral():
     assert np.isfinite(logits).all()
 
 
+def test_woq_gpt2_engine_path():
+    """The ENGINE path must skip gpt2's wte/wpe tables (ModelSpec.woq_skip)."""
+    from deepspeed_tpu.models import gpt2
+
+    reset_topology()
+    g = gpt2.GPT2Config(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64)
+    spec = gpt2.build(g)
+    from deepspeed_tpu.ops.quantizer import quantize_params as qp
+
+    gparams = qp(gpt2.init_params(g, jax.random.PRNGKey(0)), bits=8,
+                 skip=tuple(spec.woq_skip))
+    assert isinstance(gparams["wte"], jnp.ndarray)
+    assert isinstance(gparams["wpe"], jnp.ndarray)
+    l = np.asarray(jax.jit(spec.forward_fn)(
+        gparams, np.arange(8, dtype=np.int32)[None, :]))
+    assert np.isfinite(l).all()
+
+
+def test_woq_load_checkpoint_requantizes(tmp_path):
+    """load_checkpoint on a WOQ engine loads dense then re-quantizes."""
+    import deepspeed_tpu
+    from deepspeed_tpu.ops.quantizer import QuantizedWeight
+
+    reset_topology()
+    cfg = llama.LlamaConfig.tiny(VOCAB)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(cfg, ctx=ctx),
+        config={"train_micro_batch_size_per_device": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "mesh": {"data": 8}}, seed=11)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, VOCAB, (16, 16), dtype=np.int32)}
+    engine.train_batch(batch)
+    ckpt = engine.save_checkpoint(str(tmp_path / "ck"))
+    del ckpt
+    reset_topology()
+    eng = InferenceEngine(lambda ctx: llama.build(cfg, ctx=ctx),
+                          dtype=jnp.float32, quantize_bits=8)
+    before = np.asarray(eng.params["layers"]["wq"].values).copy()
+    eng.load_checkpoint(str(tmp_path / "ck"))
+    assert isinstance(eng.params["layers"]["wq"], QuantizedWeight)
+    after = np.asarray(eng.params["layers"]["wq"].values)
+    assert (before != after).any()  # trained weights actually loaded
+    out = eng.generate(np.arange(8, dtype=np.int32)[None, :], max_new_tokens=4)
+    assert out.shape == (1, 12)
+
+
 def test_glob_module_patterns():
     from deepspeed_tpu.compression.scheduler import _match
 
