@@ -172,9 +172,20 @@ def reset_topology() -> None:
         import jax
 
         jax.effects_barrier()
-        # block on every live committed array so all per-device streams drain
-        for d in jax.live_arrays():
-            d.block_until_ready()
     except Exception:
         pass
+    # block on every live committed array so all per-device streams drain;
+    # per-array guard: a deleted (donated) array raising must not skip the
+    # rest of the quiesce
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:
+        arrays = []
+    for d in arrays:
+        try:
+            d.block_until_ready()
+        except Exception:
+            pass
     _topology = None

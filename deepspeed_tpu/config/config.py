@@ -245,6 +245,15 @@ class MeshConfig(ConfigBase):
     # axes listed here are laid out over DCN (multi-slice) rather than ICI
     dcn_axes: list = field(default_factory=list)
 
+    # set by Config.from_dict when the user wrote a mesh section; a default
+    # (implicit) mesh must never tear down a pre-built topology
+    @property
+    def is_explicit(self) -> bool:
+        return self.__dict__.get("_explicit_instance", False) or self != MeshConfig()
+
+    def mark_explicit(self) -> None:
+        self.__dict__["_explicit_instance"] = True
+
     def _validate(self, path: str = "") -> None:
         for name in ("fsdp", "tensor", "sequence", "expert", "pipeline"):
             if getattr(self, name) < 1:
@@ -509,7 +518,11 @@ class Config(ConfigBase):
             zo = dict(data.get(zo_key) or {})
             zo.setdefault("zenflow", zf)
             data[zo_key] = zo
-        return super().from_dict(data, path=path)
+        mesh_written = "mesh" in data
+        obj = super().from_dict(data, path=path)
+        if mesh_written:
+            obj.mesh.mark_explicit()
+        return obj
 
     # ------------------------------------------------------------------ batch triangle
     def resolve_batch_sizes(self, dp_world_size: int) -> None:

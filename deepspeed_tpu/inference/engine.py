@@ -117,12 +117,16 @@ class InferenceEngine:
 
         target = self.params
         if getattr(self, "quantize_bits", 0):
-            target = jax.jit(
-                self.spec.init_fn, out_shardings=self.plan.param_shardings
-            )(jax.random.PRNGKey(0))
+            # dense load template: zeros with the plan's shapes/shardings
+            # (every value is overwritten by the strict loaders; running the
+            # real init would waste a full model's compute + memory)
+            abstract = jax.eval_shape(self.spec.init_fn, jax.random.PRNGKey(0))
             target = jax.tree_util.tree_map(
-                lambda x: x.astype(self.dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, target)
+                lambda s, sh: jax.device_put(
+                    jnp.zeros(s.shape,
+                              self.dtype if jnp.issubdtype(s.dtype, jnp.floating)
+                              else s.dtype), sh),
+                abstract, self.plan.param_shardings)
 
         tag = ckpt.latest_tag(ckpt_dir)
         model_dir = os.path.join(ckpt_dir, tag) if tag else ckpt_dir

@@ -1,0 +1,74 @@
+"""Model injection: HF checkpoints -> TPU-native engines in one call.
+
+Role parity with the reference ``deepspeed/module_inject`` (kernel injection
+``replace_module.py:189 replace_transformer_layer`` + per-arch policies in
+``containers/`` + ``deepspeed.init_inference(..., replace_with_kernel_inject)``
+and ``deepspeed.tp_model_init`` ``__init__.py:408``).
+
+TPU-native shape: the reference surgically rewrites a live ``nn.Module`` tree
+into fused-kernel blocks. Here the "policy" is the per-family ingestion recipe
+(``models/hf_ingest.py``) plus this repo's own functional model of the same
+architecture — instead of patching HF code, the HF *checkpoint* is mapped onto
+the TPU-first implementation (scan-stacked layers, Pallas attention, GSPMD
+TP via the sharding planner). ``replace_policy_exists`` mirrors the
+reference's policy registry surface so callers can probe support.
+"""
+
+from __future__ import annotations
+
+SUPPORTED_FAMILIES = ("llama", "gpt2", "mixtral")
+
+
+def replace_policy_exists(model_dir: str) -> bool:
+    """Whether an injection policy (ingestion recipe + TPU model) covers the
+    architecture of ``model_dir`` (reference ``replace_policy.py`` registry)."""
+    try:
+        from deepspeed_tpu.models.hf_ingest import config_from_hf
+
+        family, _ = config_from_hf(model_dir)
+        return family in SUPPORTED_FAMILIES
+    except Exception:
+        return False
+
+
+def init_inference_from_hf(model_dir: str, mp_size: int = 1, dtype=None,
+                           quantize_bits: int = 0, ragged: bool = False,
+                           ragged_config=None, **build_kwargs):
+    """HF model dir -> ready inference engine (reference
+    ``init_inference(model, replace_with_kernel_inject=True)`` +
+    ``checkpoint=`` loading path, collapsed into one call).
+
+    ``ragged=True`` returns the continuous-batching engine
+    (``inference/ragged.py``); otherwise the dense TP engine.
+    """
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.hf_ingest import from_pretrained
+
+    builder, _, params = from_pretrained(model_dir, **build_kwargs)
+    dtype = dtype if dtype is not None else jnp.bfloat16
+    if ragged:
+        from deepspeed_tpu.inference.ragged import RaggedInferenceEngine
+
+        return RaggedInferenceEngine(builder, ragged_config, dtype=dtype,
+                                     params=params,
+                                     quantize_bits=quantize_bits)
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    return InferenceEngine(builder, mp_size=mp_size, dtype=dtype,
+                           params=params, quantize_bits=quantize_bits)
+
+
+def tp_model_init_from_hf(model_dir: str, config=None, **initialize_kwargs):
+    """HF model dir -> training engine with the weights placed under the
+    plan (reference ``deepspeed.tp_model_init`` ``__init__.py:408`` —
+    TP-shard a real model for training). Returns the usual
+    ``(engine, optimizer, dataloader, scheduler)`` tuple.
+    """
+    import deepspeed_tpu
+    from deepspeed_tpu.models.hf_ingest import from_pretrained
+
+    builder, _, params = from_pretrained(model_dir)
+    return deepspeed_tpu.initialize(model=builder, config=config,
+                                    initial_params=params,
+                                    **initialize_kwargs)

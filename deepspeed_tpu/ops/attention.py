@@ -89,6 +89,9 @@ def attention(
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
+_PAGED_FALLBACK_WARNED = False
+
+
 def paged_attention(q, k_pool, v_pool, slots, positions, block_tables,
                     scale: float | None = None, impl: str = "auto"):
     """Ragged paged-KV attention: [T, Hq, D] tokens over the blocked pool
@@ -107,7 +110,16 @@ def paged_attention(q, k_pool, v_pool, slots, positions, block_tables,
 
             return paged_decode_attention(q, k_pool, v_pool, slots, positions,
                                           block_tables, scale=scale)
-        except (ImportError, NotImplementedError):
+        except (ImportError, NotImplementedError) as e:
+            global _PAGED_FALLBACK_WARNED
+            if not _PAGED_FALLBACK_WARNED:
+                _PAGED_FALLBACK_WARNED = True
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning(
+                    "paged attention: Pallas kernel unavailable (%s); "
+                    "falling back to the padded-gather XLA path — decode "
+                    "memory/latency will degrade at long contexts", e)
             impl = "xla"
     if impl != "xla":
         raise ValueError(f"unknown paged attention impl {impl!r}")

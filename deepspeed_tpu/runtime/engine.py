@@ -1486,6 +1486,23 @@ def initialize(
     cfg = load_config(config)
     if topology_initialized():
         topo = get_topology()
+        # an EXPLICIT mesh request that contradicts the live topology must
+        # not be silently ignored (e.g. an inference engine built a pure-DP
+        # mesh earlier in the process): rebuild on the requested shape. An
+        # implicit (default) mesh honors whatever topology the user built.
+        wanted = {a: getattr(cfg.mesh, a)
+                  for a in ("data", "fsdp", "tensor", "sequence", "expert",
+                            "pipeline")}
+        mismatch = [a for a, v in wanted.items()
+                    if v not in (-1, topo.size(a))]
+        if mismatch and cfg.mesh.is_explicit:
+            from deepspeed_tpu.comm.topology import reset_topology
+
+            log_dist(
+                f"mesh config requests {wanted} but the process topology is "
+                f"{dict(topo.sizes)}; rebuilding the mesh", ranks=[0])
+            reset_topology()
+            topo = dist.init_distributed(cfg.mesh, devices=mesh_devices)
     else:
         topo = dist.init_distributed(cfg.mesh, devices=mesh_devices)
     cfg.resolve_batch_sizes(topo.dp_world_size)
