@@ -353,6 +353,7 @@ class MonitorConfig(ConfigBase):
     tensorboard: dict = field(default_factory=dict)  # {enabled, output_path, job_name}
     csv_monitor: dict = field(default_factory=dict)
     wandb: dict = field(default_factory=dict)
+    comet: dict = field(default_factory=dict)  # {enabled, project, workspace, ...}
 
 
 @dataclass

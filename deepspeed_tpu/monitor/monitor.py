@@ -1,9 +1,9 @@
-"""Experiment monitoring: rank-0-gated fan-out to TensorBoard / CSV / W&B.
+"""Experiment monitoring: rank-0-gated fan-out to TensorBoard / CSV / W&B / Comet.
 
 Role parity with the reference ``monitor/monitor.py:13,30`` (``Monitor`` ABC +
-``MonitorMaster`` multiplexing ``TensorBoardMonitor``/``WandbMonitor``/
-``csvMonitor``; Comet omitted — its SDK isn't in the image and the writer
-protocol is identical). The event format matches the reference:
+``MonitorMaster`` multiplexing TensorBoard/W&B/Comet/CSV writers). Every
+writer degrades to disabled-with-a-log-line when its SDK is absent or fails
+to initialize. The event format matches the reference:
 ``write_events([(tag, value, global_step), ...])``.
 """
 
@@ -109,6 +109,42 @@ class WandbMonitor(Monitor):
             self._wandb.log({tag: value}, step=int(step))
 
 
+class CometMonitor(Monitor):
+    """Comet writer (reference ``monitor/comet.py``): rank-0 gated, lazily
+    imported, disabled with a log line when the SDK is absent."""
+
+    def __init__(self, cfg: dict):
+        self.enabled = False
+        if not _is_rank0():
+            return
+        try:
+            import comet_ml
+
+            self._experiment = comet_ml.Experiment(
+                api_key=cfg.get("api_key"),
+                project_name=cfg.get("project", "deepspeed_tpu"),
+                workspace=cfg.get("workspace"),
+            )
+            if cfg.get("experiment_name"):
+                self._experiment.set_name(cfg["experiment_name"])
+        except Exception as e:
+            # missing SDK, missing API key, offline — monitoring must never
+            # take down training startup
+            log_dist(f"comet disabled: {e}", ranks=[0])
+            return
+        self.enabled = True
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self._experiment.log_metric(tag, value, step=int(step))
+
+    def flush(self):
+        if self.enabled:
+            self._experiment.flush()
+
+
 class MonitorMaster(Monitor):
     """Fan-out to every enabled writer (reference ``MonitorMaster:30``)."""
 
@@ -121,6 +157,8 @@ class MonitorMaster(Monitor):
                 self.writers.append(CSVMonitor(config.csv_monitor))
             if config.wandb.get("enabled"):
                 self.writers.append(WandbMonitor(config.wandb))
+            if config.comet.get("enabled"):
+                self.writers.append(CometMonitor(config.comet))
         self.enabled = any(w.enabled for w in self.writers)
 
     def write_events(self, event_list):
