@@ -139,10 +139,14 @@ def trial_main():
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
         "zero_optimization": {"stage": stage},
         "mesh": mesh,
-        "activation_checkpointing": {"enabled": True, "policy": "dots_saveable"},
+        "activation_checkpointing": {
+            "enabled": e.get("BENCH_REMAT", "1") == "1",
+            "policy": e.get("BENCH_REMAT_POLICY", "dots_saveable"),
+        },
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
-        model=lambda ctx: llama.build(model_cfg, ctx=ctx, remat=True, remat_policy=None),
+        # remat/policy inherit from the config via ShardCtx (single source)
+        model=lambda ctx: llama.build(model_cfg, ctx=ctx),
         config=config,
     )
 

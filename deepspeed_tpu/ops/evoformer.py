@@ -61,8 +61,10 @@ def evoformer_attention(q, k, v, biases=(), chunk_size: int = 0):
         if b2_blk is not None:
             s = s + b2_blk.astype(jnp.float32)     # [B,1,H,C,R] broadcasts
         p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bnhcs,bnshd->bnchd", p,
-                          v.astype(jnp.float32)).astype(q.dtype)
+        # PV in v.dtype operands (fp32 accumulate on the MXU) — an fp32 GEMM
+        # here would halve throughput (same choice as xla_attention)
+        return jnp.einsum("bnhcs,bnshd->bnchd", p.astype(v.dtype), v
+                          ).astype(q.dtype)
 
     if not chunk_size or chunk_size >= r:
         return block(q, bias2)
