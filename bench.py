@@ -144,6 +144,13 @@ def trial_main():
             "policy": e.get("BENCH_REMAT_POLICY", "dots_saveable"),
         },
     }
+    if e.get("BENCH_TILED_LOGITS") == "1":
+        # ALST tiled logits loss: trades the [B*S, V] logits buffer for
+        # tiled compute — frees HBM for larger batches
+        config["sequence_parallel"] = {
+            "tiled_logits": True,
+            "tile_size": int(e.get("BENCH_TILE", "2048")),
+        }
     engine, _, _, _ = deepspeed_tpu.initialize(
         # remat/policy inherit from the config via ShardCtx (single source)
         model=lambda ctx: llama.build(model_cfg, ctx=ctx),
