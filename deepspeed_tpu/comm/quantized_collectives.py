@@ -90,6 +90,83 @@ def quantized_all_reduce(x, axis_name: str, error=None, bits: int = 8,
     return mean.astype(x.dtype), new_error.astype(jnp.float32)
 
 
+def loco_quantized_all_reduce(x, axis_name: str, error_local=None,
+                              error_server=None, bits: int = 8,
+                              block: int = 64):
+    """LOCO variant (reference ``coalesced_collectives.py:81``
+    ``loco_all_to_all_quant_reduce``): like :func:`quantized_all_reduce` but
+    the OWNER-side (second-stage) residual persists in its own buffer that
+    compensates the *next* window's reduced segment, instead of being folded
+    back into the sender-side residual. Keeping the two error sinks separate
+    lets each converge at its own stage's statistics — the property LOCO adds
+    over plain error feedback.
+
+    Returns ``(mean, new_error_local, new_error_server)``. ``error_server``
+    has the owner-segment shape: ``ceil(x.size / n)`` padded elements.
+    """
+    if bits != 8:
+        raise NotImplementedError("loco_quantized_all_reduce supports bits=8 only")
+    n = lax.axis_size(axis_name)
+    shape = x.shape
+    xf = x.astype(jnp.float32)
+    if error_local is not None:
+        xf = xf + error_local.astype(jnp.float32)
+
+    flat = _pad_to(xf.reshape(-1), n * block)
+    chunk = flat.size // n
+    chunks = flat.reshape(n, chunk)
+
+    # stage 1: quantize per destination chunk; all-to-all int8 + scales;
+    # sender keeps its own residual (for every destination)
+    qt = quantize(chunks, bits=bits, block=block)
+    e1 = flat - dequantize(qt).reshape(-1)
+    v = qt.values.reshape(n, -1)
+    s = qt.scales.reshape(n, -1)
+    v_recv = lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0)
+    s_recv = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+
+    blocks = v_recv.reshape(-1, block).astype(jnp.float32)
+    scales = s_recv.reshape(-1)
+    seg = (blocks * scales[:, None]).reshape(n, chunk).sum(axis=0) / n
+    # owner-side compensation: inject the PREVIOUS window's stage-2 residual
+    if error_server is not None:
+        seg = seg + error_server.astype(jnp.float32)
+
+    # stage 2: requantize the compensated segment; residual stays owner-side
+    qt2 = quantize(seg, bits=bits, block=block)
+    new_es = seg - dequantize(qt2).reshape(-1)[:chunk]
+    v2 = lax.all_gather(qt2.values.reshape(-1), axis_name)
+    s2 = lax.all_gather(qt2.scales, axis_name)
+    out_blocks = v2.reshape(-1, block).astype(jnp.float32)
+    out = (out_blocks * s2.reshape(-1)[:, None]).reshape(-1)[: flat.size]
+    mean = out[: xf.size].reshape(shape)
+
+    new_el = e1[: xf.size].reshape(shape)
+    return (mean.astype(x.dtype), new_el.astype(jnp.float32),
+            new_es.astype(jnp.float32))
+
+
+def loco_quantized_all_reduce_arrays(x, error_local, error_server, mesh,
+                                     axis_name: str, bits: int = 8,
+                                     block: int = 64):
+    """Array-level wrapper for :func:`loco_quantized_all_reduce` (leading
+    axis of size ``n`` sharded over ``axis_name``; the server residual is
+    per-owner-segment, also leading-axis sharded)."""
+    spec = P(axis_name)
+
+    def body(xs, el, es):
+        mean, nel, nes = loco_quantized_all_reduce(
+            xs[0], axis_name, el[0], es[0], bits=bits, block=block)
+        return mean[None], nel[None], nes[None]
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(P(None), spec, spec),
+        axis_names={axis_name}, check_vma=False,
+    )(x, error_local, error_server)
+
+
 def quantized_all_reduce_arrays(x, error, mesh, axis_name: str,
                                 bits: int = 8, block: int = 64):
     """Array-level wrapper for rank-varying inputs outside ``shard_map``:

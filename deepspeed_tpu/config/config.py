@@ -26,7 +26,12 @@ class OptimizerConfig(ConfigBase):
     type: str = "adamw"  # adamw | adam | sgd | lion | lamb | adagrad
     params: dict = field(default_factory=dict)
 
-    _SUPPORTED: ClassVar[set] = {"adam", "adamw", "sgd", "lion", "lamb", "adagrad", "muon", "onebit_adam", "onebitadam", "1bit-adam"}
+    _SUPPORTED: ClassVar[set] = {
+        "adam", "adamw", "sgd", "lion", "lamb", "adagrad", "muon",
+        "onebit_adam", "onebitadam", "1bit-adam",
+        "onebit_lamb", "onebitlamb", "1bit-lamb",
+        "zero_one_adam", "zerooneadam", "01adam", "zoadam",
+    }
 
     def _validate(self, path: str = "") -> None:
         if self.type.lower() not in self._SUPPORTED:
@@ -145,6 +150,27 @@ class ZenFlowConfig(ConfigBase):
         if self.block < 1:
             raise ConfigError(f"{path}block: must be >= 1")
 
+    @classmethod
+    def from_dict(cls, data, path: str = ""):
+        data = dict(data or {})
+        # Reference semantics (zero/config.py:172 Optional[ZenFlowConfig]):
+        # the PRESENCE of a zenflow block under zero_optimization enables it
+        # (including an empty all-defaults block). With enabled left unset,
+        # presence therefore means "on" — otherwise a ported reference config
+        # trains dense with no warning. (This classmethod only runs when the
+        # user actually wrote a zenflow key; the default_factory path never
+        # comes through here.)
+        if "enabled" not in data:
+            data["enabled"] = True
+        # Reference ZenFlowConfig defaults these to "auto"; configure_zenflow
+        # resolves them to step-based values. Accept the spelling and map it
+        # to this build's step-based defaults.
+        if is_auto(data.get("select_interval")):
+            data["select_interval"] = cls.select_interval
+        if is_auto(data.get("update_interval")):
+            data["update_interval"] = cls.update_interval
+        return super().from_dict(data, path=path)
+
 
 @dataclass
 class ZeroConfig(ConfigBase):
@@ -168,6 +194,11 @@ class ZeroConfig(ConfigBase):
     # ZeRO++ qgZ: int8-quantized gradient reduction with error feedback
     # (comm/quantized_collectives.py; requires a pure data-parallel mesh)
     quantized_gradients: bool = False
+    # ZeRO++ qwZ: int8 blockwise-quantized weight all-gather on the stage-3
+    # path (parallel/qwz.py; reference partition_parameters.py:1446 quantized
+    # all_gather_coalesced). Halves the dominant stage-3 collective.
+    quantized_weights: bool = False
+    qwz_block: int = 128
     # ZenFlow split update over the offloaded tier (runtime/zenflow.py)
     zenflow: ZenFlowConfig = field(default_factory=ZenFlowConfig)
     # MiCS / ZeRO++ hpZ: optimizer+gradient state shards over the FULL world
@@ -180,6 +211,12 @@ class ZeroConfig(ConfigBase):
     def _validate(self, path: str = "") -> None:
         if self.stage not in (0, 1, 2, 3):
             raise ConfigError(f"{path}stage: must be 0..3, got {self.stage}")
+        if self.quantized_weights and self.stage != 3:
+            raise ConfigError(
+                f"{path}quantized_weights: qwZ quantizes the stage-3 weight "
+                f"all-gather; it requires stage 3 (got stage {self.stage})")
+        if self.qwz_block < 1:
+            raise ConfigError(f"{path}qwz_block: must be >= 1")
 
     @classmethod
     def from_dict(cls, data, path: str = ""):
@@ -201,16 +238,11 @@ class ZeroConfig(ConfigBase):
                     "secondary-partition group is the mesh's fsdp axis)."
                 )
                 data["hierarchical_partitioning"] = True
-        # Reference knobs this build doesn't implement: accept + warn rather
-        # than hard-failing ported DeepSpeed configs.
-        if "quantized_weights" in data:
-            from deepspeed_tpu.utils.logging import logger
-
-            logger.warning(
-                f"Config field '{path}quantized_weights' is not supported in "
-                "this build and is ignored."
-            )
-            data.pop("quantized_weights")
+        # Reference spelling for qwZ (`zero_quantized_weights`).
+        if "zero_quantized_weights" in data and "quantized_weights" not in data:
+            data["quantized_weights"] = data.pop("zero_quantized_weights")
+        else:
+            data.pop("zero_quantized_weights", None)
         # Legacy `cpu_offload` was a bool; translate to an offload tier, not a rename.
         if "cpu_offload" in data:
             from deepspeed_tpu.utils.logging import logger

@@ -79,6 +79,13 @@ class RaggedConfig:
     block_size: int = 16
     num_blocks: int = 257  # 256 usable + scratch
     max_blocks_per_seq: int = 32
+    # decode run-ahead: when the scheduler has no prefill or admission work,
+    # run up to this many decode steps inside ONE jitted lax.scan (greedy
+    # next-token fed back on device) instead of one dispatch per token —
+    # the multi-step-scheduling idiom of continuous-batching engines, and
+    # the difference between dispatch-latency-bound and compute-bound decode
+    # on remote/tunneled accelerators. 0 disables.
+    decode_run_ahead: int = 0
 
     @property
     def max_seq_len(self) -> int:
@@ -180,6 +187,7 @@ class RaggedInferenceEngine:
             b *= 2
         self._buckets.append(self.cfg.max_tokens_per_step)
         self._step_jit = self._build_step()
+        self._chunk_jit = None  # decode run-ahead program (lazy)
         # scheduling efficiency telemetry (padding fraction; comparable to the
         # dense engine's pad-to-max waste)
         self.tokens_scheduled = 0
@@ -266,11 +274,85 @@ class RaggedInferenceEngine:
 
         return jax.jit(step_fn, donate_argnums=(1,))
 
+    def _build_decode_chunk(self) -> Callable:
+        """K fused greedy decode steps over the paged cache: one dispatch,
+        next-token argmax fed back on device, KV scattered per step. ``K`` is
+        static (jit specializes per (K, batch) pair)."""
+        fwd = self.spec.ragged_forward_fn
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+        def chunk_fn(k, params, cache, tokens, slots, positions, block_tables):
+            def one(carry, _):
+                cache, toks, pos = carry
+                logits, cache = fwd(params, toks, slots, pos, block_tables, cache)
+                nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+                return (cache, nxt, pos + 1), nxt
+
+            (cache, _, _), out = jax.lax.scan(
+                one, (cache, tokens, positions), None, length=k)
+            return out, cache  # out: [K, T] generated tokens
+
+        return chunk_fn
+
+    def _try_decode_run_ahead(self) -> dict | None:
+        """Fused multi-step decode when the scheduler is quiescent: every
+        running sequence is decoding and no admission can happen (queue empty
+        or no free slot). Returns the emit dict, or None to fall back to the
+        single SplitFuse step."""
+        k_max = self.cfg.decode_run_ahead
+        seqs = list(self._running.values())
+        if (k_max < 2 or not seqs
+                or any(not s.in_decode for s in seqs)
+                or (self._queued and self._free_slots)):
+            return None
+        k = min(k_max, min(s.max_new_tokens - len(s.generated) for s in seqs))
+        while k >= 2 and not all(self._ensure_capacity(s, s.pos + k)
+                                 for s in seqs):
+            k -= 1  # pool pressure: partial growth is kept, retry smaller
+        if k < 2:
+            return None
+        t = len(seqs)
+        bucket = next(b for b in self._buckets if b >= t)
+        tokens = np.zeros(bucket, np.int32)
+        slots = np.full(bucket, self.cfg.max_seqs, np.int32)
+        positions = np.zeros(bucket, np.int32)
+        for j, s in enumerate(seqs):
+            tokens[j] = s.token_at(s.pos)
+            slots[j] = s.slot
+            positions[j] = s.pos
+        if self._chunk_jit is None:
+            self._chunk_jit = self._build_decode_chunk()
+        out, self.cache = self._chunk_jit(
+            k, self.params, self.cache,
+            jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(positions),
+            jnp.asarray(self.block_tables),
+        )
+        out = np.asarray(out)  # [K, bucket]
+        self.tokens_scheduled += k * t
+        self.tokens_padded += k * (bucket - t)
+        emit: dict = {}
+        for j, s in enumerate(seqs):
+            for i in range(k):
+                tok = int(out[i, j])
+                s.generated.append(tok)
+                s.pos += 1
+                emit[s.uid] = tok
+                if s.finished:
+                    break  # tokens past EOS stay in the pool; freed on release
+            if s.finished:
+                self._release(s)
+        return emit
+
     def step(self) -> dict:
         """One SplitFuse step. Returns {uid: token} for sequences that emitted
-        a token this step."""
+        a token this step (under decode run-ahead: the LAST token of each
+        sequence's chunk; the full stream is in the per-sequence state)."""
         if not self.has_work:
             return {}
+        ahead = self._try_decode_run_ahead()
+        if ahead is not None:
+            return ahead
         budget = self.cfg.max_tokens_per_step
         tokens = np.zeros(budget, np.int32)
         slots = np.full(budget, self.cfg.max_seqs, np.int32)  # padding row

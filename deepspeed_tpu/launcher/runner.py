@@ -94,6 +94,64 @@ def build_node_cmd(script: str, script_args: list[str], coordinator: str,
     return f"{exports} cd {shlex.quote(os.getcwd())}; {sys.executable} {shlex.quote(script)} {args}"
 
 
+def build_runner(args, extra_env: dict[str, str]):
+    """Map parsed CLI args to a MultiNodeRunner (reference ``runner.py``'s
+    PDSH/Slurm/MPI selection, TPU-idiomatic backends)."""
+    from deepspeed_tpu.launcher.multinode_runner import (
+        GcloudTPURunner,
+        GKERunner,
+        SlurmRunner,
+        SSHRunner,
+    )
+
+    if args.launcher == "slurm":
+        if not args.num_nodes and not args.hostfile:
+            raise ValueError("--launcher slurm needs --num_nodes or --hostfile")
+        if args.hostfile:
+            hosts = filter_hosts(fetch_hostfile(args.hostfile),
+                                 args.include, args.exclude)
+            names = list(hosts)
+            nodelist = ",".join(names)
+            n = len(names)
+        else:
+            nodelist, n = "", args.num_nodes
+        coord_host = args.master_addr or (nodelist.split(",")[0] if nodelist
+                                          else None)
+        if coord_host is None:
+            # a per-task shell fallback like $SLURMD_NODENAME cannot work:
+            # the env export is quoted (no expansion), and even expanded each
+            # rank would name ITSELF rather than one common coordinator
+            raise ValueError(
+                "--launcher slurm with --num_nodes needs --master_addr "
+                "(or a --hostfile to take the first host from)")
+        return SlurmRunner(
+            args.script, args.script_args, num_nodes=n,
+            coordinator=f"{coord_host}:{args.master_port}",
+            nodelist=nodelist, partition=args.partition,
+            account=args.account, extra_env=extra_env)
+    if args.launcher == "gcloud":
+        if not args.tpu_name or not args.zone:
+            raise ValueError("--launcher gcloud needs --tpu_name and --zone")
+        return GcloudTPURunner(
+            args.script, args.script_args, tpu_name=args.tpu_name,
+            zone=args.zone, project=args.project, extra_env=extra_env)
+    if args.launcher == "gke":
+        if not args.num_nodes or not args.image:
+            raise ValueError("--launcher gke needs --num_nodes and --image")
+        return GKERunner(
+            args.script, args.script_args, job_name=args.job_name,
+            num_nodes=args.num_nodes, image=args.image,
+            tpu_topology=args.tpu_topology, accelerator=args.accelerator,
+            extra_env=extra_env)
+    # default: raw SSH over the hostfile
+    hosts = filter_hosts(fetch_hostfile(args.hostfile), args.include, args.exclude)
+    names = list(hosts)
+    coordinator = f"{args.master_addr or names[0]}:{args.master_port}"
+    return SSHRunner(args.script, args.script_args, hosts=names,
+                     coordinator=coordinator, ssh_port=args.ssh_port,
+                     extra_env=extra_env)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="dstpu", description="deepspeed_tpu multi-host launcher"
@@ -106,32 +164,40 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--ssh_port", type=int, default=22)
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--launcher", default="ssh",
+                        choices=("ssh", "slurm", "gcloud", "gke"),
+                        help="multinode fan-out backend")
+    # slurm
+    parser.add_argument("--num_nodes", type=int, default=0)
+    parser.add_argument("--partition", default="")
+    parser.add_argument("--account", default="")
+    # gcloud tpu-vm
+    parser.add_argument("--tpu_name", default="")
+    parser.add_argument("--zone", default="")
+    parser.add_argument("--project", default="")
+    # gke
+    parser.add_argument("--image", default="")
+    parser.add_argument("--job_name", default="dstpu-job")
+    parser.add_argument("--tpu_topology", default="")
+    parser.add_argument("--accelerator", default="")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
     extra_env = propagate_env()
 
-    if args.hostfile is None:
+    if args.hostfile is None and args.launcher == "ssh":
         # single-host: exec in place, jax discovers local devices itself
         cmd = [sys.executable, args.script] + args.script_args
         logger.info(f"dstpu single-host: {' '.join(cmd)}")
         return subprocess.call(cmd, env={**os.environ, **extra_env})
 
-    hosts = filter_hosts(fetch_hostfile(args.hostfile), args.include, args.exclude)
-    names = list(hosts)
-    coordinator = f"{args.master_addr or names[0]}:{args.master_port}"
-    procs = []
-    for pid, host in enumerate(names):
-        node_cmd = build_node_cmd(args.script, args.script_args, coordinator,
-                                  len(names), pid, extra_env)
-        ssh = ["ssh", "-p", str(args.ssh_port), host, node_cmd]
-        logger.info(f"dstpu launching on {host} (process {pid}/{len(names)})")
-        procs.append(subprocess.Popen(ssh))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+    runner = build_runner(args, extra_env)
+    if not runner.backend_exists():
+        logger.warning(f"launcher backend {runner.name!r} tooling not found "
+                       "on PATH; the generated commands may fail")
+    logger.info(f"dstpu launching via {runner.name}")
+    return runner.launch()
 
 
 if __name__ == "__main__":

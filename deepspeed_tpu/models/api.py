@@ -66,6 +66,21 @@ class ShardCtx:
     # attention-visible sequence (under Ulysses: the full gathered sequence)
     fpdt_chunks: int = 0
     fpdt_offload: bool = True
+    # ZeRO++ qwZ hook (parallel/qwz.py): installed by the engine when
+    # zero_optimization.quantized_weights is on; applied to each scanned
+    # layer's weight slice so the stage-3 gather rides int8
+    qwz: Any = None
+
+    def layer_weights(self, lp: dict, dtype) -> dict:
+        """Per-layer weight preparation, called first thing in layer bodies:
+        just-in-time WOQ dequantization (inference), then the qwZ quantized
+        gather (stage-3 training) when installed and constraints are live."""
+        from deepspeed_tpu.ops.quantizer import dequantize_layer
+
+        lp = dequantize_layer(lp, dtype)
+        if self.qwz is not None and not getattr(self, "_suspend_constraints", False):
+            lp = self.qwz(lp, dtype)
+        return lp
 
     @property
     def sp_degree(self) -> int:
@@ -178,6 +193,10 @@ class ShardCtx:
     def constrain(self, x: jnp.ndarray, *logical_dims: Optional[str]) -> jnp.ndarray:
         if self.mesh is None or getattr(self, "_suspend_constraints", False):
             return x
+        # inside a PARTIAL-manual shard_map (e.g. the qgZ step is manual over
+        # the data axis only), constraints stay live for the auto axes but
+        # must not mention the manual ones
+        manual = getattr(self, "_manual_axes", ()) or ()
         spec = []
         for dim in logical_dims:
             axis = self.rules.get(dim) if dim is not None else None
@@ -186,7 +205,8 @@ class ShardCtx:
                 spec.append(None)
                 continue
             axes = axis if isinstance(axis, tuple) else (axis,)
-            active = tuple(a for a in axes if self.mesh.shape.get(a, 1) > 1)
+            active = tuple(a for a in axes
+                           if self.mesh.shape.get(a, 1) > 1 and a not in manual)
             spec.append(active if len(active) > 1 else (active[0] if active else None))
         pspec = jax.sharding.PartitionSpec(*spec)
         return jax.lax.with_sharding_constraint(

@@ -97,9 +97,7 @@ def layernorm(x, g, b, eps):
 
 
 def _block(cfg: GPT2Config, ctx: ShardCtx, attn_impl: str, x, lp):
-    from deepspeed_tpu.ops.quantizer import dequantize_layer
-
-    lp = dequantize_layer(lp, x.dtype)  # WOQ no-op on dense weights
+    lp = ctx.layer_weights(lp, x.dtype)  # WOQ dequant + qwZ gather hooks
     b, s, d = x.shape
     h = layernorm(x, lp["ln1_g"], lp["ln1_b"], cfg.layer_norm_eps)
     q = (h @ lp["wq"] + lp["bq"]).reshape(b, s, cfg.num_heads, cfg.hd)

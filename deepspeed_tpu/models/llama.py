@@ -115,7 +115,7 @@ from deepspeed_tpu.ops.quantizer import dequantize_layer as _dq_layer  # noqa: E
 
 def _decoder_layer(cfg: LlamaConfig, ctx: ShardCtx, attn_impl: str,
                    x: jnp.ndarray, lp: dict, positions: jnp.ndarray | None = None) -> jnp.ndarray:
-    lp = _dq_layer(lp, x.dtype)
+    lp = ctx.layer_weights(lp, x.dtype)
     b, s, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     if positions is None:

@@ -110,9 +110,7 @@ PARAM_LOGICAL_AXES = {
 
 def _layer(cfg: MixtralConfig, moe_cfg: MoEConfig, ctx: ShardCtx, attn_impl: str,
            train: bool, x, lp, positions, rng):
-    from deepspeed_tpu.ops.quantizer import dequantize_layer
-
-    lp = dequantize_layer(lp, x.dtype)  # WOQ no-op on dense weights
+    lp = ctx.layer_weights(lp, x.dtype)  # WOQ dequant + qwZ gather hooks
     b, s, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
 

@@ -5,8 +5,8 @@ Role parity with the reference's optimizer zoo (``ops/adam/fused_adam.py``,
 ``ops/muon`` + ``runtime/engine.py:1960 _configure_basic_optimizer``) — on TPU
 the "fused multi-tensor kernel" concern disappears: optax transforms compile to
 fused XLA loops over the (sharded) flat param pytree, which is exactly what
-``multi_tensor_adam.cu`` hand-builds. A Pallas fused-update kernel slots in
-behind the same interface for the hot path (see ``ops/pallas``).
+``multi_tensor_adam.cu`` hand-builds — no hand-written kernel is needed or
+provided for the update itself.
 
 ``build_optimizer(config, schedule)`` returns an ``optax.GradientTransformation``
 whose learning rate is the jittable schedule, so the whole update (lr included)
@@ -79,6 +79,31 @@ def build_optimizer(
             parts.append(optax.add_decayed_weights(wd))
         parts.append(optax.scale_by_learning_rate(lr))
         return optax.chain(*parts)
+    if t in ("onebit_lamb", "onebitlamb", "1bit-lamb"):
+        tx = scale_by_onebit_lamb(
+            warmup_steps=int(p.get("freeze_step", p.get("warmup_steps", 100))),
+            max_coeff=float(p.get("max_coeff", 10.0)),
+            min_coeff=float(p.get("min_coeff", 0.01)),
+            coeff_ratio=float(p.get("coeff_ratio", 2.0)),
+            **_adam_args(p),
+        )
+        parts = [tx]
+        if wd:
+            parts.append(optax.add_decayed_weights(wd))
+        parts.append(optax.scale_by_learning_rate(lr))
+        return optax.chain(*parts)
+    if t in ("zero_one_adam", "zerooneadam", "01adam", "zoadam"):
+        tx = scale_by_zero_one_adam(
+            var_freeze_step=int(p.get("var_freeze_step", 100)),
+            var_update_scaler=int(p.get("var_update_scaler", 16)),
+            local_step_scaler=int(p.get("local_step_scaler", 32768)),
+            **_adam_args(p),
+        )
+        parts = [tx]
+        if wd:
+            parts.append(optax.add_decayed_weights(wd))
+        parts.append(optax.scale_by_learning_rate(lr))
+        return optax.chain(*parts)
     raise ValueError(f"unsupported optimizer type {cfg.type!r}")
 
 
@@ -121,6 +146,122 @@ def scale_by_onebit_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
         nu_count = jnp.minimum(count, warmup_steps)
         mc = 1 - b1 ** count.astype(jnp.float32)
         vc = 1 - b2 ** nu_count.astype(jnp.float32)
+        out = jax.tree_util.tree_map(
+            lambda m, v: (m / mc) / (jnp.sqrt(v / vc) + eps), mu, nu)
+        return out, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
+
+
+def scale_by_onebit_lamb(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                         warmup_steps: int = 100,
+                         max_coeff: float = 10.0, min_coeff: float = 0.01,
+                         coeff_ratio: float = 2.0) -> optax.GradientTransformation:
+    """1-bit LAMB semantics (reference ``runtime/fp16/onebit/lamb.py``):
+    exact LAMB during warmup; after the freeze step the VARIANCE freezes —
+    the property that makes compressed momentum communication safe, exactly
+    as in 1-bit Adam — while the layerwise trust ratio stays live. The live
+    trust ratio is the stabilizer: it renormalizes the update to the param
+    norm, so a drifting momentum over a frozen variance cannot blow the step
+    size up (it is computed locally from norms, no extra communication).
+    ``min_coeff``/``max_coeff`` bound it (reference lamb coefficient bounds);
+    ``coeff_ratio`` is accepted for reference-config compatibility.
+    """
+    del coeff_ratio
+    import jax
+    import jax.numpy as jnp
+
+    if warmup_steps < 1:
+        raise ValueError("onebit_lamb freeze_step must be >= 1")
+
+    def trust(p, u):
+        pn = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+        un = jnp.linalg.norm(u.astype(jnp.float32).reshape(-1))
+        raw = jnp.where((pn > 0.0) & (un > 0.0), pn / un, 1.0)
+        return jnp.clip(raw, min_coeff, max_coeff)
+
+    def init(params):
+        mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        nu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("onebit_lamb needs params (trust-ratio scaling)")
+        count = state.count + 1
+        in_warmup = count <= warmup_steps
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            state.mu, updates)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(
+                in_warmup, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
+            state.nu, updates)
+        nu_count = jnp.minimum(count, warmup_steps)
+        mc = 1 - b1 ** count.astype(jnp.float32)
+        vc = 1 - b2 ** nu_count.astype(jnp.float32)
+        raw = jax.tree_util.tree_map(
+            lambda m, v: (m / mc) / (jnp.sqrt(v / vc) + eps), mu, nu)
+        out = jax.tree_util.tree_map(
+            lambda p, u: trust(p, u) * u, params, raw)
+        return out, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
+
+
+def scale_by_zero_one_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                           var_freeze_step: int = 100,
+                           var_update_scaler: int = 16,
+                           local_step_scaler: int = 32768
+                           ) -> optax.GradientTransformation:
+    """0/1 Adam semantics (reference ``runtime/fp16/onebit/zoadam.py``):
+    the variance is refreshed only at exponentially sparsifying intervals
+    (every ``2^(k/var_update_scaler)`` steps, the reference's adaptive
+    variance-update policy) and freezes entirely after ``var_freeze_step`` —
+    by making variance updates rare from the START, both gradient and
+    momentum communication can be compressed for the whole run (the "0" in
+    0/1: some steps skip synchronization entirely; here the optimizer math is
+    exact at every step and only the variance refresh is sparse, which is the
+    part that gates compression safety).
+
+    ``local_step_scaler`` is accepted for reference-config compatibility (it
+    tunes the learning-rate-scaled local-step policy of the reference's
+    communication skipping, which XLA's fused reduction replaces).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    del local_step_scaler
+    if var_freeze_step < 1:
+        raise ValueError("zero_one_adam var_freeze_step must be >= 1")
+
+    def init(params):
+        mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        nu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update(updates, state, params=None):
+        del params
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        # exponentially sparsifying refresh: interval doubles every
+        # var_update_scaler steps; always refresh during the first interval
+        k = jnp.floor(cf / float(var_update_scaler))
+        interval = jnp.exp2(jnp.minimum(k, 30.0)).astype(jnp.int32)
+        refresh = jnp.logical_and(count <= var_freeze_step,
+                                  (count % jnp.maximum(interval, 1)) == 0)
+        refresh = jnp.logical_or(refresh, count <= var_update_scaler)
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            state.mu, updates)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(
+                refresh, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
+            state.nu, updates)
+        mc = 1 - b1 ** cf
+        # variance bias correction tracks the number of refreshes, which the
+        # sparse schedule makes step-dependent; clamp by the freeze horizon
+        vc = 1 - b2 ** jnp.minimum(cf, float(var_freeze_step))
         out = jax.tree_util.tree_map(
             lambda m, v: (m / mc) / (jnp.sqrt(v / vc) + eps), mu, nu)
         return out, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)

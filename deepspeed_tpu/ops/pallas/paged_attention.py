@@ -98,15 +98,21 @@ def paged_decode_attention(q, k_pool, v_pool, slots, positions, block_tables,
     rep = hq // hkv
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
 
+    # Past the token's last valid block (j > pos // bs) the index map clamps
+    # to that last block: the pipeline sees an unchanged block id, skips the
+    # DMA, and the body's `pl.when` predicate skips the compute — so decode
+    # bandwidth scales with the actual context, not the table width, and
+    # nothing is ever read through freed/stale block_tables entries.
+    def _kv_map(t, j, sl, po, bt):
+        return (bt[sl[t], jnp.minimum(j, po[t] // bs)], 0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(t_tokens, mb),
         in_specs=[
             pl.BlockSpec((1, hq, d), lambda t, j, sl, po, bt: (t, 0, 0)),
-            pl.BlockSpec((1, bs, hkv, d),
-                         lambda t, j, sl, po, bt: (bt[sl[t], j], 0, 0, 0)),
-            pl.BlockSpec((1, bs, hkv, d),
-                         lambda t, j, sl, po, bt: (bt[sl[t], j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, hkv, d), _kv_map),
+            pl.BlockSpec((1, bs, hkv, d), _kv_map),
         ],
         out_specs=pl.BlockSpec((1, hq, d), lambda t, j, sl, po, bt: (t, 0, 0)),
         scratch_shapes=[

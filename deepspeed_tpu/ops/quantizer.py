@@ -67,6 +67,55 @@ def dequantize(qt: QuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
     return _from_blocks(vals, qt.shape, dtype)
 
 
+def quantize_rows(x: jnp.ndarray, block: int = 128):
+    """Shape-preserving symmetric int8 quantization with per-block scales
+    along the LAST dim: ``x [..., L] -> (q int8 [..., L], scales [..., L/block])``.
+
+    Unlike :func:`quantize` (which flattens), the output dims map 1:1 onto the
+    input dims, so a sharded ``x`` quantizes shard-locally whenever the block
+    axis isn't split mid-block — the property the ZeRO++ qwZ gather relies on
+    (``parallel/qwz.py``; reference ``csrc/quantization/swizzled_quantize.cu``
+    quantizes the local partition before the all-gather).
+    """
+    L = x.shape[-1]
+    pad = (-L) % block
+    xf = x.astype(jnp.float32)
+    if pad:
+        xf = jnp.pad(xf, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    nb = xf.shape[-1] // block
+    blocks = xf.reshape(*xf.shape[:-1], nb, block)
+    absmax = jnp.max(jnp.abs(blocks), axis=-1)
+    scales = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(blocks / scales[..., None]), -127, 127).astype(jnp.int8)
+    q = q.reshape(*xf.shape[:-1], nb * block)
+    if pad:
+        q = q[..., :L]
+    return q, scales
+
+
+def dequantize_rows(q: jnp.ndarray, scales: jnp.ndarray, dtype=jnp.float32,
+                    block: int | None = None) -> jnp.ndarray:
+    """Inverse of :func:`quantize_rows`. ``block`` must be passed when the
+    last dim was padded (it cannot be inferred from the shapes then)."""
+    L = q.shape[-1]
+    nb = scales.shape[-1]
+    if block is None:
+        if L % nb:
+            raise ValueError(
+                f"dequantize_rows: last dim {L} not divisible by {nb} blocks; "
+                "pass the block size used at quantization")
+        block = L // nb
+    pad = nb * block - L
+    qf = q.astype(jnp.float32)
+    if pad:
+        qf = jnp.pad(qf, [(0, 0)] * (q.ndim - 1) + [(0, pad)])
+    vals = qf.reshape(*qf.shape[:-1], nb, block) * scales[..., None]
+    vals = vals.reshape(*qf.shape[:-1], nb * block)
+    if pad:
+        vals = vals[..., :L]
+    return vals.astype(dtype)
+
+
 def quantize_dequantize(x: jnp.ndarray, bits: int = 8, block: int = 256) -> jnp.ndarray:
     """Fake-quant round trip (reference ``fake_quantizer.cu``; QAT + tests)."""
     return dequantize(quantize(x, bits=bits, block=block), dtype=x.dtype)

@@ -1,0 +1,97 @@
+"""Domino-style TP compute/communication overlap.
+
+Role parity with the reference Domino (``runtime/domino/transformer.py:250
+DominoTransformerLayer`` + ``async_linear.py``): split the batch so one
+split's tensor-parallel reduction overlaps the other split's compute, hiding
+the TP collective behind the MXU.
+
+Why this needs explicit structure on TPU (committed finding, see
+``docs/TP_OVERLAP.md`` and ``tests/unit/test_tp_overlap.py``; measured on
+XLA's v5e:2x4 AOT target):
+
+1. GSPMD lowers the TP row-parallel reduction to a SYNCHRONOUS ``all-reduce``
+   op — no ``all-reduce-start/done`` pair appears in the optimized schedule,
+   under any async/LHS compiler flag probed. A sequential decoder chain gives
+   the scheduler nothing to overlap anyway (each block depends on the
+   previous reduction).
+2. Naive split-batch under GSPMD is DEFEATED by the compiler: two half-batch
+   chains through the same weights get re-merged (6 expected all-reduces
+   compile to 3) — the compiler undoes the Domino restructure.
+3. ``collective-permute`` IS async on this target (``-start/-done`` pairs in
+   the final schedule, with independent fusions placed inside the windows).
+
+So the TPU-expressible Domino is: a ``shard_map`` manual over the tensor
+axis, batch split inside, each split's partial output reduced by an async
+ppermute RING whose transfer windows the latency-hiding scheduler fills with
+the other split's matmuls. The ring is mathematically the psum (exact, same
+reduction order on every rank).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.topology import AXIS_TENSOR
+
+
+def ring_all_reduce(x, axis_name: str):
+    """Sum-allreduce as n-1 async ppermute hops (collective-permute lowers to
+    start/done pairs on TPU — overlappable; sync ``all-reduce`` is not)."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc = x
+    buf = x
+    for _ in range(n - 1):
+        buf = lax.ppermute(buf, axis_name, perm)
+        acc = acc + buf
+    return acc
+
+
+def domino_apply(partial_fn: Callable, x, weights: Sequence,
+                 weight_specs: Sequence, mesh, axis: str = AXIS_TENSOR,
+                 splits: int = 2):
+    """Run ``partial_fn(x_chunk, *weights) -> partial`` over ``splits`` batch
+    chunks inside a shard_map manual over ``axis``; each chunk's sum-reduction
+    is an async ppermute ring, so chunk k+1's compute fills chunk k's
+    transfer windows (the Domino overlap).
+
+    ``weight_specs``: the manual-axis PartitionSpec per weight (other mesh
+    axes stay GSPMD-auto). ``x`` enters replicated over ``axis``.
+    """
+    if x.shape[0] % splits:
+        raise ValueError(f"batch {x.shape[0]} not divisible by {splits} splits")
+
+    def local(x, *ws):
+        chunks = jnp.split(x, splits, axis=0)
+        outs = [ring_all_reduce(partial_fn(c, *ws), axis) for c in chunks]
+        return jnp.concatenate(outs, axis=0)
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(),) + tuple(weight_specs),
+        out_specs=P(),
+        axis_names={axis}, check_vma=False,
+    )(x, *weights)
+
+
+def domino_swiglu_mlp(x, w_gate, w_up, w_down, mesh, axis: str = AXIS_TENSOR,
+                      splits: int = 2):
+    """Split-batch SwiGLU TP MLP (the Domino transformer's MLP half):
+    ``w_gate``/``w_up`` column-parallel on ``axis``, ``w_down`` row-parallel;
+    each batch split's down-projection partial rides the async ring."""
+
+    def partial_mlp(h, wg, wu, wd):
+        return (jax.nn.silu(h @ wg) * (h @ wu)) @ wd
+
+    return domino_apply(
+        partial_mlp, x, (w_gate, w_up, w_down),
+        (P(None, axis), P(None, axis), P(axis, None)),
+        mesh, axis=axis, splits=splits,
+    )

@@ -1,0 +1,110 @@
+"""ZeRO++ qwZ: int8 blockwise-quantized weight all-gather on the stage-3 path.
+
+Role parity with the reference's quantized weight gather
+(``runtime/zero/partition_parameters.py:1446 all_gather_coalesced`` quantized
+path + ``csrc/quantization/swizzled_quantize.cu``): under ZeRO-3 the dominant
+collective is the per-layer parameter all-gather; qwZ halves it by gathering
+int8 weights + per-block scales instead of bf16, dequantizing after the wire.
+
+TPU-native mechanism (not a port): stage-3 gathers here are not explicit
+collectives — they are GSPMD reshardings XLA inserts where the scanned layer
+body consumes the fsdp-sharded weight slice. To move that resharding onto an
+int8 payload, the layer body routes its weights through
+:func:`quantized_gather` (via ``ShardCtx.layer_weights``): quantize the
+still-sharded slice shard-locally (``ops/quantizer.quantize_rows``), constrain
+the int8 values + scales to the fsdp-DROPPED sharding — forcing the all-gather
+to ride int8 — then dequantize to the compute dtype on the far side. XLA's
+latency-hiding scheduler still prefetches layer k+1's (now ~2x smaller) gather
+during layer k's compute, so the reference's prefetch coordinator remains
+subsumed. Backward is straight-through (``jax.custom_vjp`` identity): the
+cotangent of the full weight flows back unquantized and the existing grad
+sharding constraints reduce-scatter it, exactly the reference semantics (qwZ
+quantizes the weight wire, never the gradient math — that is qgZ's job,
+``comm/quantized_collectives.py``).
+
+Per-leaf policy: only leaves whose slice is actually fsdp-sharded and at least
+``min_size`` elements quantize; tensor/expert-sharded dims KEEP their sharding
+in the gather target (qwZ composes with TP — only the fsdp axis is gathered).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.comm.topology import AXIS_FSDP
+from deepspeed_tpu.ops.quantizer import dequantize_rows, quantize_rows
+
+
+def _drop_fsdp(entry):
+    """Remove the fsdp axis from one PartitionSpec entry."""
+    if entry == AXIS_FSDP:
+        return None
+    if isinstance(entry, tuple) and AXIS_FSDP in entry:
+        rest = tuple(a for a in entry if a != AXIS_FSDP)
+        return rest[0] if len(rest) == 1 else (rest if rest else None)
+    return entry
+
+
+def _has_fsdp(spec: PartitionSpec) -> bool:
+    return any(e == AXIS_FSDP or (isinstance(e, tuple) and AXIS_FSDP in e)
+               for e in spec)
+
+
+def quantized_gather(w, mesh, slice_spec: PartitionSpec, block: int):
+    """quantize -> gather(int8) -> dequantize, straight-through backward.
+
+    ``w``: a layer weight slice (logical full shape) whose sharding includes
+    the fsdp axis per ``slice_spec``. Returns the logically-identical weight
+    with the fsdp axis gathered, where the resharding payload was int8.
+    """
+    gathered = PartitionSpec(*(_drop_fsdp(e) for e in slice_spec))
+    q_sh = NamedSharding(mesh, gathered)
+    # scales [..., nb]: same leading dims, last dim shrinks by the block
+    # factor — the gathered spec transfers dim-for-dim
+    s_sh = q_sh
+
+    @jax.custom_vjp
+    def f(x):
+        q, s = quantize_rows(x, block=block)
+        q = jax.lax.with_sharding_constraint(q, q_sh)
+        s = jax.lax.with_sharding_constraint(s, s_sh)
+        return dequantize_rows(q, s, x.dtype, block=block)
+
+    f.defvjp(lambda x: (f(x), None), lambda _, g: (g,))
+    return f(w)
+
+
+def build_layer_hook(mesh, stacked_layer_specs, block: int = 128,
+                     min_size: int = 65536):
+    """Build the per-layer weight hook the engine installs on ``ShardCtx``.
+
+    ``stacked_layer_specs``: the ``"layers"`` subtree of the plan's
+    param_specs — PartitionSpecs of the STACKED leaves (leading layers dim).
+    Returns ``hook(lp, dtype) -> lp`` operating on the scan body's sliced
+    layer dict (leading dim dropped), quantize-gathering exactly the leaves
+    the plan fsdp-shards.
+    """
+    specs_flat, specs_def = jax.tree_util.tree_flatten(
+        stacked_layer_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def hook(lp, dtype):
+        del dtype  # slices arrive already compute-cast
+        lp_flat, lp_def = jax.tree_util.tree_flatten(lp)
+        if lp_def != specs_def:
+            # structure mismatch (e.g. a model passing a sub-dict): skip
+            # rather than mis-pair leaves
+            return lp
+        out = []
+        for w, spec in zip(lp_flat, specs_flat):
+            sl = PartitionSpec(*spec[1:]) if len(spec) > 0 else PartitionSpec()
+            if (not hasattr(w, "ndim") or w.ndim < 2 or w.size < min_size
+                    or not _has_fsdp(sl)
+                    or not jnp.issubdtype(w.dtype, jnp.floating)):
+                out.append(w)
+            else:
+                out.append(quantized_gather(w, mesh, sl, block))
+        return jax.tree_util.tree_unflatten(lp_def, out)
+
+    return hook

@@ -121,6 +121,36 @@ class Engine:
             hierarchical=zero.hierarchical_partitioning,
         )
 
+        # ZeRO++ qwZ: route the scanned layer weights through the int8
+        # quantized gather (parallel/qwz.py; reference
+        # partition_parameters.py:1446 quantized all_gather_coalesced).
+        # Installed on the shard_ctx AFTER model build — the model closures
+        # hold the (mutable) ctx, so the hook reaches every layer body.
+        if zero.quantized_weights:
+            if topo.size("pipeline") > 1:
+                raise ValueError(
+                    "quantized_weights does not compose with pipeline "
+                    "parallelism (the stage body runs manual-SPMD where the "
+                    "qwZ gather constraint has no meaning); drop one")
+            if topo.size("fsdp") <= 1:
+                log_dist(
+                    "quantized_weights: fsdp axis is 1 — stage-3 has no "
+                    "weight gather to quantize; running dense", ranks=[0])
+            else:
+                from deepspeed_tpu.parallel import qwz as qwz_mod
+
+                specs = self.plan.param_specs
+                if not (isinstance(specs, dict) and "layers" in specs):
+                    raise ValueError(
+                        "quantized_weights requires a model with a stacked "
+                        "'layers' param subtree (the scanned stage-3 path)")
+                self.shard_ctx.qwz = qwz_mod.build_layer_hook(
+                    topo.mesh, specs["layers"], block=zero.qwz_block)
+                log_dist(
+                    "stage-3 weight all-gather: int8 blockwise (qwZ, block="
+                    f"{zero.qwz_block}) over fsdp={topo.size('fsdp')}",
+                    ranks=[0])
+
         # ---- params (fp32 master), placed per plan (reference zero.Init analog)
         seed = seed if seed is not None else config.seed
         init_rng = jax.random.PRNGKey(seed)
@@ -314,30 +344,43 @@ class Engine:
         self._qgrad = bool(zero.quantized_gradients)
         self._qgrad_error = None
         if self._qgrad:
-            others = [a for a in ("fsdp", "tensor", "sequence", "pipeline", "expert")
+            others = [a for a in ("tensor", "sequence", "pipeline", "expert")
                       if topo.size(a) > 1]
             if topo.size("data") <= 1 or others:
                 raise ValueError(
-                    "zero_optimization.quantized_gradients requires a pure "
-                    f"data-parallel mesh (data>1); got data={topo.size('data')}"
-                    + (f", active axes {others}" if others else "")
+                    "zero_optimization.quantized_gradients reduces over the "
+                    f"data axis (data>1 required; composes with fsdp); got "
+                    f"data={topo.size('data')}"
+                    + (f", unsupported axes {others}" if others else "")
                 )
+            if zero.hierarchical_partitioning:
+                raise ValueError(
+                    "quantized_gradients does not compose with "
+                    "hierarchical_partitioning (hpZ masters shard over the "
+                    "data axis the quantized reducer runs manual over)")
             if self._offload_mode == "nvme":
                 raise ValueError(
                     "quantized_gradients is not supported with NVMe-offloaded "
                     "optimizer state")
             n = topo.size("data")
-            err_sh = NamedSharding(topo.mesh, PartitionSpec("data"))
+            # residuals: one per data rank, each carrying the grad's fsdp
+            # sharding on the param dims (no replicated full-size buffers)
+            err_shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(
+                    topo.mesh, PartitionSpec("data", *spec)),
+                self.plan.grad_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
             self._qgrad_error = jax.jit(
                 lambda: jax.tree_util.tree_map(
                     lambda p: jnp.zeros((n,) + tuple(p.shape), jnp.float32),
                     self.params,
                 ),
-                out_shardings=jax.tree_util.tree_map(
-                    lambda _: err_sh, self.params),
+                out_shardings=err_shardings,
             )()
             log_dist("gradient reduction: int8 quantized (qgZ) over the data "
-                     f"axis (n={n}) with error feedback", ranks=[0])
+                     f"axis (n={n}) with error feedback"
+                     + (f", fsdp={topo.size('fsdp')} auto"
+                        if topo.size("fsdp") > 1 else ""), ranks=[0])
 
         # ZenFlow split update over the offloaded tier (runtime/zenflow.py;
         # reference runtime/zenflow/zenflow_stage_1_and_2.py:47)
@@ -419,16 +462,37 @@ class Engine:
 
     def _constrain_grads(self, grads):
         if getattr(self, "_inside_manual_region", False):
-            # shard_map body (quantized reduction): GSPMD constraints over the
-            # manual axis are meaningless/invalid there
-            return jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32), grads)
-        ns = self._grad_ns()
+            # qgZ shard_map body: manual over the data axis only — constrain
+            # to the grad specs with the manual axis dropped, so fsdp/ZeRO
+            # sharding stays declared on the auto axes
+            ns = self._manual_grad_ns()
+        else:
+            ns = self._grad_ns()
         return jax.tree_util.tree_map(
             lambda g, s: jax.lax.with_sharding_constraint(g.astype(jnp.float32), s),
             grads,
             ns,
         )
+
+    def _manual_grad_ns(self):
+        """Gradient shardings usable inside the qgZ partial-manual region:
+        grad specs with the manual (data) axis entries filtered out."""
+        manual = {"data"}
+
+        def filt(spec):
+            entries = []
+            for e in spec:
+                if isinstance(e, tuple):
+                    rest = tuple(a for a in e if a not in manual)
+                    entries.append(rest[0] if len(rest) == 1
+                                   else (rest if rest else None))
+                else:
+                    entries.append(None if e in manual else e)
+            return NamedSharding(self.topo.mesh, PartitionSpec(*entries))
+
+        return jax.tree_util.tree_map(
+            filt, self.plan.grad_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
 
     def _microbatch_grads(self, params, mb, rng, scale, step=None):
         """Scaled-loss grads for one microbatch, fp32, ZeRO-sharded."""
@@ -488,11 +552,16 @@ class Engine:
         }
         return new_params, new_opt, new_scale, metrics
 
-    def _offload_group_walk(self, p_leaves, opt_groups, g_leaves, lr, finite):
+    def _offload_group_walk(self, p_leaves, opt_groups, g_leaves, lr, finite,
+                            hot_idx=None):
         """Windowed sub-group update over host-pinned optimizer state
         (reference ``stage3.py:2360 _prepare_sub_group``): stream one group's
         state HBM-ward, update, stream back — shared by the dense offload tail
-        and the zenflow cold update. All writes guarded by ``finite``."""
+        and the zenflow cold update. All writes guarded by ``finite``.
+
+        ``hot_idx``: per-leaf ZenFlow hot block indices; when set, the Adam
+        moments at hot blocks are restored after the update (the selective
+        optimizer owns them — see ``zenflow.restore_hot_opt_state``)."""
         from deepspeed_tpu.runtime import offload as offload_mod
 
         new_p = list(p_leaves)
@@ -507,6 +576,10 @@ class Engine:
                 pg, jax.tree_util.tree_map(lambda u: u * lr, updates))
             newp = _tree_select(finite, newp, pg)
             new_state = _tree_select(finite, new_state, state)
+            if hot_idx is not None:
+                new_state = self._zf.restore_hot_opt_state(
+                    new_state, state, tuple(hot_idx[i] for i in idx),
+                    self.config.zero_optimization.zenflow.block)
             new_opt.append(offload_mod.stream_out(new_state, store_sh))
             for j, i in enumerate(idx):
                 new_p[i] = newp[j]
@@ -537,17 +610,16 @@ class Engine:
             loss, acc = self._microbatch_grads(params, mb, rng, scale, step=step)
             losses = loss[None]
         else:
-            if getattr(self, "_inside_manual_region", False):
-                acc0 = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            else:
-                acc0 = jax.tree_util.tree_map(
-                    lambda p, s: jax.lax.with_sharding_constraint(
-                        jnp.zeros(p.shape, jnp.float32), s
-                    ),
-                    params,
-                    self._grad_ns(),
-                )
+            ns = (self._manual_grad_ns()
+                  if getattr(self, "_inside_manual_region", False)
+                  else self._grad_ns())
+            acc0 = jax.tree_util.tree_map(
+                lambda p, s: jax.lax.with_sharding_constraint(
+                    jnp.zeros(p.shape, jnp.float32), s
+                ),
+                params,
+                ns,
+            )
 
             def micro(acc, idx_mb):
                 idx, mb = idx_mb
@@ -577,10 +649,13 @@ class Engine:
         return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2))
 
     def _build_train_batch_fn_qgrad(self):
-        """Fused step with qgZ gradient reduction: the GAS fwd/bwd runs PER
-        DATA RANK inside shard_map (no implicit psum), then each grad leaf
-        reduces once through the int8 quantized collective with error
-        feedback; the optimizer tail runs on the replicated result."""
+        """Fused step with qgZ gradient reduction (reference ZeRO++
+        ``all_to_all_quant_reduce``, ``coalesced_collectives.py:31``): the GAS
+        fwd/bwd runs PER DATA RANK inside a shard_map that is manual over the
+        DATA axis only — fsdp (and the ZeRO-2/3 shardings that live on it)
+        stays GSPMD-auto inside the body — then each grad leaf reduces once
+        over data through the int8 quantized collective with error feedback;
+        the optimizer tail runs on the fsdp-sharded result."""
         from deepspeed_tpu.comm.quantized_collectives import quantized_all_reduce
         from deepspeed_tpu.comm.topology import AXIS_DATA
 
@@ -590,13 +665,13 @@ class Engine:
                            batch, qerr):
             def local(params, batch, qerr):
                 self._inside_manual_region = True
-                self.shard_ctx._suspend_constraints = True
+                self.shard_ctx._manual_axes = {AXIS_DATA}
                 try:
                     loss, acc = self._gas_grads(
                         params, scale_state, step, base_rng, batch)
                 finally:
                     self._inside_manual_region = False
-                    self.shard_ctx._suspend_constraints = False
+                    self.shard_ctx._manual_axes = ()
                 g_leaves, tdef = jax.tree_util.tree_flatten(acc)
                 e_leaves = jax.tree_util.tree_leaves(qerr)
                 red, nerr = [], []
@@ -843,7 +918,8 @@ class Engine:
             n = jnp.maximum(n_acc, 1).astype(jnp.float32)
             g_leaves = [a / n for a in acc_leaves]
             new_p, new_opt = self._offload_group_walk(
-                p_leaves, opt_groups, g_leaves, lr, any_acc)
+                p_leaves, opt_groups, g_leaves, lr, any_acc,
+                hot_idx=idx_leaves)
             new_p = [
                 self._zf.restore_hot(old, new, hidx, block)
                 for old, new, hidx in zip(p_leaves, new_p, idx_leaves)

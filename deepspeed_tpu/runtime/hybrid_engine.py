@@ -1,24 +1,52 @@
 """Hybrid engine: train + generate on the same weights (RLHF loop).
 
 Role parity with the reference ``runtime/hybrid_engine.py:30
-DeepSpeedHybridEngine`` (mode-switching between training and inference for
-RLHF: gather ZeRO-3 params into inference containers, generate rollouts, flip
-back to training).
+DeepSpeedHybridEngine`` + ``runtime/rollout/hybrid_engine_rollout.py``
+(mode-switching between training and inference for RLHF: gather ZeRO-3 params
+into inference containers, generate rollout batches, flip back to training).
 
 TPU-native shape: no containers or mode flips — the training engine's params
-ARE the generation params. ``generate`` casts the current fp32 masters to the
-inference dtype and runs the jitted KV-cache decode; ZeRO-3 sharded params
-stay sharded (GSPMD gathers per layer during decode exactly as in the training
-forward). The reference's ``_zero3_release`` bookkeeping disappears.
+ARE the generation params. What the reference's machinery buys is kept, in
+JAX form:
+
+- *one-time eval-mode cast* (ref: the container build): fp32 masters are cast
+  to the inference dtype ONCE per training step and reused across every
+  rollout ``generate`` call of that step (``_eval_params``), instead of
+  per-call.
+- *rollout batching* (ref ``hybrid_engine_rollout.py``): ``generate_rollouts``
+  drives a whole prompt set through length-bucketed, padded generation
+  batches and returns sequences + per-token logprobs (what a PPO/GRPO loss
+  consumes).
+- *KV persistence across calls* (ref: the shared inference KV workspace):
+  ``prefill`` / ``decode_more`` carry the cache between calls, so multi-turn
+  rollouts never re-prefill; the cache buffer is donated through each step.
+
+ZeRO-3 sharded params stay sharded throughout — GSPMD gathers per layer
+during decode exactly as in the training forward; the reference's
+``_zero3_release`` bookkeeping disappears.
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.runtime.engine import Engine
+
+
+@dataclass
+class GenState:
+    """Persistent generation state carried across ``decode_more`` calls."""
+
+    cache: Any            # paged-dense KV cache [L, B, max_len, Hkv, Dh]
+    last_logits: Any      # [B, V] logits of the last processed position
+    pos: int              # next write position
+    tokens: np.ndarray    # [B, pos] everything processed so far (host)
+    max_len: int
 
 
 class HybridEngine(Engine):
@@ -30,17 +58,35 @@ class HybridEngine(Engine):
             raise ValueError(f"model {self.model_spec.name} has no decode support")
         self.inference_dtype = inference_dtype
         self._gen_cache: dict = {}
+        self._prefill_cache: dict = {}
+        self._decode_cache: dict = {}
+        self._cast_jit = None
+        self._eval_params = None
+        self._eval_step = -1
 
+    # ------------------------------------------------------------- eval cast
+    @property
+    def eval_params(self):
+        """Inference-dtype view of the CURRENT weights, cast once per
+        training step (the reference's one-time container build per rollout
+        phase) and shared by every generate call until the next train step."""
+        if self._eval_params is None or self._eval_step != self.global_steps:
+            if self._cast_jit is None:
+                dtype = self.inference_dtype
+                self._cast_jit = jax.jit(lambda p: jax.tree_util.tree_map(
+                    lambda x: x.astype(dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, p))
+            self._eval_params = self._cast_jit(self.params)
+            self._eval_step = self.global_steps
+        return self._eval_params
+
+    # -------------------------------------------------------------- generate
     def _build_generate(self, batch: int, prompt_len: int, max_new: int, sample: bool):
         decode = self.model_spec.decode_fn
         init_cache = self.model_spec.init_cache_fn
         dtype = self.inference_dtype
 
-        def generate_fn(params, tokens, rng, temperature):
-            cparams = jax.tree_util.tree_map(
-                lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                params,
-            )
+        def generate_fn(cparams, tokens, rng, temperature):
             cache = init_cache(batch, prompt_len + max_new, dtype)
             logits, cache = decode(cparams, tokens, cache, 0)
             last = logits[:, prompt_len - 1].astype(jnp.float32)
@@ -50,16 +96,18 @@ class HybridEngine(Engine):
                 r = jax.random.fold_in(rng, i)
                 tok = (jax.random.categorical(r, last / temperature) if sample
                        else jnp.argmax(last, axis=-1)).astype(jnp.int32)
+                lp = jax.nn.log_softmax(last, axis=-1)
+                tok_lp = jnp.take_along_axis(lp, tok[:, None], axis=-1)[:, 0]
                 logits, cache = decode(cparams, tok[:, None], cache, prompt_len + i)
-                return (logits[:, 0].astype(jnp.float32), cache), tok
+                return (logits[:, 0].astype(jnp.float32), cache), (tok, tok_lp)
 
-            (_, _), toks = jax.lax.scan(step, (last, cache), jnp.arange(max_new))
-            return toks.T
+            (_, _), (toks, lps) = jax.lax.scan(step, (last, cache), jnp.arange(max_new))
+            return toks.T, lps.T  # [B, max_new] tokens + logprobs
 
         return jax.jit(generate_fn)
 
     def generate(self, input_ids, max_new_tokens: int = 64, temperature: float = 0.0,
-                 seed: int | None = None):
+                 seed: int | None = None, return_logprobs: bool = False):
         """Rollout generation on the CURRENT training weights."""
         input_ids = np.asarray(input_ids)
         b, t = input_ids.shape
@@ -68,8 +116,114 @@ class HybridEngine(Engine):
         if key not in self._gen_cache:
             self._gen_cache[key] = self._build_generate(b, t, max_new_tokens, sample)
         rng = jax.random.PRNGKey(seed) if seed is not None else self._next_rng()
-        toks = self._gen_cache[key](
-            self.params, jnp.asarray(input_ids), rng,
+        toks, lps = self._gen_cache[key](
+            self.eval_params, jnp.asarray(input_ids), rng,
             jnp.float32(max(temperature, 1e-6)),
         )
-        return np.concatenate([input_ids, np.asarray(toks)], axis=1)
+        full = np.concatenate([input_ids, np.asarray(toks)], axis=1)
+        if return_logprobs:
+            return full, np.asarray(lps)
+        return full
+
+    # ------------------------------------------------------------- rollouts
+    def generate_rollouts(self, prompts, rollout_batch_size: int = 8,
+                          max_new_tokens: int = 64, temperature: float = 1.0,
+                          seed: int | None = None, pad_token_id: int = 0):
+        """Batched rollout over a prompt SET (reference
+        ``hybrid_engine_rollout.py``): prompts are grouped by EXACT length —
+        padding between a prompt and its continuation would make the policy
+        condition on pad tokens, poisoning the returned logprobs — and each
+        group generates in batches of ``rollout_batch_size``.
+
+        Returns a list of dicts (input order preserved):
+        ``{"prompt", "tokens", "logprobs", "full"}``.
+        """
+        del pad_token_id  # kept for API compatibility; exact-length grouping
+        prompts = [np.asarray(p).reshape(-1).astype(np.int32) for p in prompts]
+        out: list = [None] * len(prompts)
+        base_seed = seed if seed is not None else int(
+            jax.random.randint(self._next_rng(), (), 0, 2**31 - 1))
+        by_len: dict = {}
+        for i, p in enumerate(prompts):
+            by_len.setdefault(len(p), []).append(i)
+        call = 0
+        for length in sorted(by_len):
+            idxs = by_len[length]
+            for start in range(0, len(idxs), rollout_batch_size):
+                idx = idxs[start:start + rollout_batch_size]
+                batch = np.stack([prompts[i] for i in idx])
+                full, lps = self.generate(
+                    batch, max_new_tokens=max_new_tokens,
+                    temperature=temperature, seed=base_seed + call,
+                    return_logprobs=True)
+                call += 1
+                for j, i in enumerate(idx):
+                    out[i] = {
+                        "prompt": prompts[i],
+                        "tokens": full[j, length:],
+                        "logprobs": lps[j],
+                        "full": full[j],
+                    }
+        return out
+
+    # ---------------------------------------------------- persistent KV API
+    def prefill(self, input_ids, max_len: int) -> GenState:
+        """Process a prompt batch into a persistent KV state (the reference's
+        shared inference workspace): follow with ``decode_more`` any number
+        of times — multi-turn rollouts never re-prefill."""
+        input_ids = np.asarray(input_ids)
+        b, t = input_ids.shape
+        if t > max_len:
+            raise ValueError(f"prompt {t} exceeds max_len {max_len}")
+        decode = self.model_spec.decode_fn
+        init_cache = self.model_spec.init_cache_fn
+        key = (b, t, max_len)
+        if key not in self._prefill_cache:
+            dtype = self.inference_dtype
+
+            def prefill_fn(cparams, tokens):
+                cache = init_cache(b, max_len, dtype)
+                logits, cache = decode(cparams, tokens, cache, 0)
+                return logits[:, t - 1].astype(jnp.float32), cache
+
+            self._prefill_cache[key] = jax.jit(prefill_fn)
+        last, cache = self._prefill_cache[key](self.eval_params,
+                                               jnp.asarray(input_ids))
+        return GenState(cache=cache, last_logits=last, pos=t,
+                        tokens=input_ids.copy(), max_len=max_len)
+
+    def decode_more(self, state: GenState, n_tokens: int,
+                    temperature: float = 0.0, seed: int | None = None) -> GenState:
+        """Extend a ``GenState`` by ``n_tokens`` greedy/sampled tokens in one
+        jitted scan; the incoming cache buffer is donated to the step."""
+        if state.pos + n_tokens > state.max_len:
+            raise ValueError(
+                f"decode_more past max_len: {state.pos}+{n_tokens} > {state.max_len}")
+        b = state.tokens.shape[0]
+        decode = self.model_spec.decode_fn
+        sample = temperature > 0.0
+        key = (b, n_tokens, state.max_len, sample)
+        if key not in self._decode_cache:
+
+            def decode_fn(cparams, last, cache, pos, rng, temperature):
+                def step(carry, i):
+                    last, cache = carry
+                    r = jax.random.fold_in(rng, i)
+                    tok = (jax.random.categorical(r, last / temperature) if sample
+                           else jnp.argmax(last, axis=-1)).astype(jnp.int32)
+                    logits, cache = decode(cparams, tok[:, None], cache, pos + i)
+                    return (logits[:, 0].astype(jnp.float32), cache), tok
+
+                (last, cache), toks = jax.lax.scan(
+                    step, (last, cache), jnp.arange(n_tokens))
+                return last, cache, toks.T
+
+            self._decode_cache[key] = jax.jit(decode_fn, donate_argnums=(2,))
+        rng = jax.random.PRNGKey(seed) if seed is not None else self._next_rng()
+        last, cache, toks = self._decode_cache[key](
+            self.eval_params, state.last_logits, state.cache,
+            jnp.int32(state.pos), rng, jnp.float32(max(temperature, 1e-6)))
+        return GenState(
+            cache=cache, last_logits=last, pos=state.pos + n_tokens,
+            tokens=np.concatenate([state.tokens, np.asarray(toks)], axis=1),
+            max_len=state.max_len)

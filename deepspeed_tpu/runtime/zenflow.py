@@ -134,6 +134,40 @@ def restore_hot(p_old, p_new, idx, block: int):
     return pb.reshape(-1)[:n].reshape(p_old.shape).astype(p_new.dtype)
 
 
+def restore_hot_opt_state(new_state, old_state, hot_idx, block: int):
+    """Restore the Adam moments at hot blocks after the cold group walk.
+
+    The cold update sees zero gradients at hot blocks, so without this the
+    offloaded moments there decay by beta per window and a block returning to
+    the cold set carries artificially shrunk m/v. The reference
+    ``ZenFlowCPUAdam`` skips the selected columns outright; here we undo the
+    decay the same way ``restore_hot`` undoes the param write. (The optax
+    step counter is a single scalar per group and still advances — same as
+    the reference CPU optimizer's global step.)
+
+    ``hot_idx`` is a tuple of per-leaf hot block indices parallel to the
+    group's param leaves.
+    """
+    import optax
+
+    def fix(new, old):
+        if not isinstance(new, optax.ScaleByAdamState):
+            return new
+
+        def rest(tree_new, tree_old):
+            leaves_n, tdef = jax.tree_util.tree_flatten(tree_new)
+            leaves_o = jax.tree_util.tree_leaves(tree_old)
+            out = [restore_hot(o, n, hi, block)
+                   for n, o, hi in zip(leaves_n, leaves_o, hot_idx)]
+            return jax.tree_util.tree_unflatten(tdef, out)
+
+        return new._replace(mu=rest(new.mu, old.mu), nu=rest(new.nu, old.nu))
+
+    return jax.tree_util.tree_map(
+        fix, new_state, old_state,
+        is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState))
+
+
 def reset_moments(hot: dict, new_idx: list) -> dict:
     """Re-selection (reference select_interval boundary): blocks retained in
     the hot set carry their moments and bias-correction counter over; only

@@ -88,6 +88,93 @@ def test_node_cmd_env():
     assert "train.py --foo 1" in cmd
 
 
+# ------------------------------------------------------- multinode runners
+def test_ssh_runner_cmds():
+    from deepspeed_tpu.launcher.multinode_runner import SSHRunner
+
+    r = SSHRunner("train.py", ["--foo", "1"], hosts=["h0", "h1"],
+                  coordinator="h0:29500", ssh_port=2222,
+                  extra_env={"K": "v"})
+    cmds = r.get_cmd()
+    assert len(cmds) == 2
+    assert cmds[0][:3] == ["ssh", "-p", "2222"]
+    assert cmds[1][3] == "h1"
+    assert "export DSTPU_PROCESS_ID=1;" in cmds[1][4]
+    assert "export DSTPU_NUM_PROCESSES=2;" in cmds[0][4]
+    assert "export K=v;" in cmds[0][4]
+    assert "train.py --foo 1" in cmds[0][4]
+
+
+def test_slurm_runner_cmd():
+    from deepspeed_tpu.launcher.multinode_runner import SlurmRunner
+
+    r = SlurmRunner("train.py", ["--n", "2"], num_nodes=4,
+                    coordinator="n0:29500", nodelist="n0,n1,n2,n3",
+                    partition="tpu", account="ml")
+    (cmd,) = r.get_cmd()
+    s = " ".join(cmd[:-1])
+    assert cmd[0] == "srun"
+    assert "--nodes 4" in s and "--ntasks 4" in s and "--ntasks-per-node 1" in s
+    assert "--nodelist n0,n1,n2,n3" in s and "--partition tpu" in s
+    assert "--account ml" in s
+    # rank wiring resolves on the allocation, not at submit time
+    assert "export DSTPU_PROCESS_ID=$SLURM_PROCID;" in cmd[-1]
+    assert "export DSTPU_COORDINATOR=n0:29500;" in cmd[-1]
+    assert "train.py --n 2" in cmd[-1]
+
+
+def test_gcloud_runner_cmd():
+    from deepspeed_tpu.launcher.multinode_runner import GcloudTPURunner
+
+    r = GcloudTPURunner("train.py", [], tpu_name="pod-a", zone="us-east5-a",
+                        project="proj")
+    (cmd,) = r.get_cmd()
+    s = " ".join(cmd)
+    assert "gcloud compute tpus tpu-vm ssh pod-a" in s
+    assert "--zone us-east5-a" in s and "--worker=all" in s
+    assert "--project proj" in s
+    # TPU runtime wires ranks itself: no DSTPU_* env injected
+    assert "DSTPU_COORDINATOR" not in cmd[-1]
+
+
+def test_gke_runner_manifest():
+    from deepspeed_tpu.launcher.multinode_runner import GKERunner
+
+    r = GKERunner("train.py", ["--x"], job_name="j1", num_nodes=8,
+                  image="gcr.io/p/i:tag", tpu_topology="4x8",
+                  accelerator="tpu-v5p-slice", extra_env={"A": "b"})
+    m = r.get_manifest()
+    assert "kind: JobSet" in m and "name: j1" in m
+    assert "parallelism: 8" in m and "completions: 8" in m
+    assert "gke-tpu-topology: 4x8" in m
+    assert "gke-tpu-accelerator: tpu-v5p-slice" in m
+    assert "python train.py --x" in m
+    assert "name: A" in m
+    assert r.get_cmd() == [["kubectl", "apply", "-f", "-"]]
+
+
+def test_cli_builds_slurm_runner(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("n0 slots=1\nn1 slots=1\n")
+    import argparse
+
+    args = argparse.Namespace(
+        hostfile=str(hf), include="", exclude="", master_addr=None,
+        master_port=29500, ssh_port=22, launcher="slurm", num_nodes=0,
+        partition="", account="", tpu_name="", zone="", project="",
+        image="", job_name="dstpu-job", tpu_topology="", accelerator="",
+        script="t.py", script_args=[])
+    r = runner.build_runner(args, {})
+    assert r.name == "slurm" and r.num_nodes == 2
+    assert r.coordinator == "n0:29500"
+    # no hostfile and no master_addr: a per-task shell fallback cannot name
+    # one common coordinator — must be a hard error
+    args.hostfile = None
+    args.num_nodes = 4
+    with pytest.raises(ValueError, match="master_addr"):
+        runner.build_runner(args, {})
+
+
 # ----------------------------------------------------------------- elasticity
 def test_compatible_world_sizes():
     # batch 64, micro in {2,4}: every w dividing 32 works

@@ -95,6 +95,86 @@ def test_hybrid_train_and_generate():
     assert out2.shape == (2, 8)
 
 
+def test_hybrid_eval_cast_reused_within_step():
+    """The eval-dtype cast happens once per training step (the reference's
+    one-time container build), not once per generate call."""
+    engine = _make_hybrid()
+    p1 = engine.eval_params
+    engine.generate(np.zeros((2, 4), np.int32), max_new_tokens=2)
+    assert engine.eval_params is p1  # same object across rollout calls
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, VOCAB, (engine.train_batch_size, 16),
+                                       dtype=np.int32)}
+    engine.train_batch(batch)
+    assert engine.eval_params is not p1  # new weights -> fresh cast
+
+
+def test_hybrid_kv_persistence_matches_oneshot():
+    """prefill + repeated decode_more must produce exactly the one-shot
+    greedy generation — the KV carried across calls is the same cache."""
+    engine = _make_hybrid()
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(1, VOCAB, (2, 6), dtype=np.int32)
+    oneshot = engine.generate(prompt, max_new_tokens=6)
+
+    state = engine.prefill(prompt, max_len=16)
+    state = engine.decode_more(state, 2)
+    state = engine.decode_more(state, 4)
+    np.testing.assert_array_equal(state.tokens, oneshot)
+    assert state.pos == 12
+
+    with pytest.raises(ValueError, match="max_len"):
+        engine.decode_more(state, 10)
+
+
+def test_hybrid_rollout_batching_and_logprobs():
+    """generate_rollouts covers a mixed-length prompt set with bucketed
+    batches; logprobs are the sampled tokens' true log-probabilities
+    (greedy: argmax => logprob is the max-entry logprob, finite, <= 0)."""
+    engine = _make_hybrid()
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, VOCAB, (L,), dtype=np.int32)
+               for L in (3, 7, 7, 5, 3, 9)]
+    rolls = engine.generate_rollouts(prompts, rollout_batch_size=2,
+                                     max_new_tokens=4, temperature=0.0,
+                                     seed=0)
+    assert len(rolls) == 6
+    for r, p in zip(rolls, prompts):
+        np.testing.assert_array_equal(r["prompt"], p)
+        assert r["tokens"].shape == (4,)
+        assert r["logprobs"].shape == (4,)
+        assert np.all(np.isfinite(r["logprobs"])) and np.all(r["logprobs"] <= 0)
+        np.testing.assert_array_equal(r["full"], np.concatenate([p, r["tokens"]]))
+
+
+def test_hybrid_ppo_shaped_loop():
+    """Miniature RLHF loop (rejection-sampling flavor): generate rollouts →
+    reward → train on the best half → generate again. Training loss must
+    descend and generation must stay shape-coherent on the updated weights."""
+    engine = _make_hybrid()
+    rng = np.random.default_rng(3)
+    target = 7  # reward: occurrences of a target token in the continuation
+    losses = []
+    for it in range(3):
+        prompts = [rng.integers(1, VOCAB, (6,), dtype=np.int32)
+                   for _ in range(8)]
+        rolls = engine.generate_rollouts(prompts, rollout_batch_size=4,
+                                         max_new_tokens=6, temperature=1.0,
+                                         seed=it)
+        scored = sorted(rolls, key=lambda r: -int(np.sum(r["tokens"] == target)))
+        best = scored[:4]
+        width = max(len(r["full"]) for r in best)
+        batch = np.zeros((engine.train_batch_size, width), np.int32)
+        for j in range(engine.train_batch_size):
+            seq = best[j % len(best)]["full"]
+            batch[j, :len(seq)] = seq
+        losses.append(float(engine.train_batch({"input_ids": batch})))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses  # learns the selected rollouts
+    out = engine.generate(np.zeros((2, 4), np.int32), max_new_tokens=3)
+    assert out.shape == (2, 7)
+
+
 def test_tensor_fragment_apis():
     from deepspeed_tpu.utils import tensor_fragment as tf
 

@@ -88,6 +88,65 @@ class TestRaggedEngine:
         for uid in prompts:
             assert got[uid] == [int(t) for t in ref[uid]], uid
 
+    def test_decode_run_ahead_token_parity(self):
+        """The fused multi-step decode (decode_run_ahead) must emit exactly
+        the per-step engine's greedy tokens — it only changes dispatch
+        granularity, never the math."""
+        prompts = _prompts(7)
+        max_new = 9
+        base = RaggedInferenceEngine(
+            lambda ctx: llama.build(CFG, ctx=ctx), RCFG,
+            dtype=jnp.float32, seed=0,
+        )
+        for uid, p in prompts.items():
+            base.put(uid, p, max_new_tokens=max_new)
+        expect = base.generate_all()
+
+        import dataclasses
+
+        fused = RaggedInferenceEngine(
+            lambda ctx: llama.build(CFG, ctx=ctx),
+            dataclasses.replace(RCFG, decode_run_ahead=4),
+            dtype=jnp.float32, seed=0,
+        )
+        for uid, p in prompts.items():
+            fused.put(uid, p, max_new_tokens=max_new)
+        got = fused.generate_all()
+        assert got == expect
+        # the run-ahead path actually engaged: far fewer host steps than
+        # tokens generated would imply is impossible to check directly, but
+        # the chunk program must have compiled
+        assert fused._chunk_jit is not None
+
+    def test_run_ahead_respects_eos_and_limits(self):
+        """EOS inside a fused chunk truncates the stream exactly as the
+        per-step path does, and max_new_tokens is never exceeded."""
+        import dataclasses
+
+        prompts = _prompts(11)
+        base = RaggedInferenceEngine(
+            lambda ctx: llama.build(CFG, ctx=ctx), RCFG,
+            dtype=jnp.float32, seed=0,
+        )
+        for uid, p in prompts.items():
+            base.put(uid, p, max_new_tokens=7)
+        expect = base.generate_all()
+        # pick an eos that actually appears mid-stream for at least one seq
+        eos = next((t for toks in expect.values() for t in toks[:-1]), None)
+
+        fused = RaggedInferenceEngine(
+            lambda ctx: llama.build(CFG, ctx=ctx),
+            dataclasses.replace(RCFG, decode_run_ahead=5),
+            dtype=jnp.float32, seed=0, eos_token_id=eos,
+        )
+        for uid, p in prompts.items():
+            fused.put(uid, p, max_new_tokens=7)
+        got = fused.generate_all()
+        for uid, toks in got.items():
+            assert len(toks) <= 7
+            if eos in toks:
+                assert toks.index(eos) == len(toks) - 1  # truncated at EOS
+
     def test_continuous_admission(self):
         """A request put() mid-flight (while others decode) still matches the
         dense reference — continuous batching semantics."""

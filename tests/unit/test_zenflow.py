@@ -84,6 +84,60 @@ def test_restore_hot():
     assert (np.asarray(out[BLOCK:]) == 1).all()
 
 
+def test_restore_hot_opt_state_undoes_moment_decay():
+    import optax
+
+    # two leaves, one hot block each; the cold walk saw zero grads at hot
+    # blocks, decaying mu/nu there — the restore must undo exactly that
+    old_mu = (jnp.full((2 * BLOCK,), 1.0), jnp.full((BLOCK,), 2.0))
+    new_mu = (jnp.full((2 * BLOCK,), 0.9), jnp.full((BLOCK,), 1.8))
+    old = optax.ScaleByAdamState(count=jnp.int32(3), mu=old_mu, nu=old_mu)
+    new = optax.ScaleByAdamState(count=jnp.int32(4), mu=new_mu, nu=new_mu)
+    hot_idx = (jnp.array([1], jnp.int32), jnp.array([0], jnp.int32))
+    out = zenflow.restore_hot_opt_state(new, old, hot_idx, BLOCK)
+    # leaf 0: block 1 hot -> old values; block 0 cold -> new values
+    np.testing.assert_allclose(np.asarray(out.mu[0][:BLOCK]), 0.9)
+    np.testing.assert_allclose(np.asarray(out.mu[0][BLOCK:]), 1.0)
+    # leaf 1: its only block is hot -> fully restored
+    np.testing.assert_allclose(np.asarray(out.nu[1]), 2.0)
+    assert int(out.count) == 4  # scalar step counter untouched
+
+
+def test_config_zero_zenflow_block_presence_enables():
+    # reference semantics: a zenflow block under zero_optimization means ON
+    # (zero/config.py:172 Optional[ZenFlowConfig]); enabled left unset must
+    # not silently train dense
+    cfg = Config.from_dict({
+        "train_micro_batch_size_per_device": 1,
+        "zero_optimization": {"stage": 2, "zenflow": {"topk_ratio": 0.1}},
+    })
+    assert cfg.zero_optimization.zenflow.enabled
+    # an EMPTY block (all reference defaults) is also "present" => enabled
+    cfg = Config.from_dict({
+        "train_micro_batch_size_per_device": 1,
+        "zero_optimization": {"stage": 2, "zenflow": {}},
+    })
+    assert cfg.zero_optimization.zenflow.enabled
+    # an explicit enabled: false is honored
+    cfg = Config.from_dict({
+        "train_micro_batch_size_per_device": 1,
+        "zero_optimization": {
+            "stage": 2, "zenflow": {"enabled": False, "topk_ratio": 0.1}},
+    })
+    assert not cfg.zero_optimization.zenflow.enabled
+
+
+def test_config_zenflow_accepts_auto_intervals():
+    # reference ZenFlowConfig defaults select/update intervals to "auto"
+    cfg = Config.from_dict({
+        "train_micro_batch_size_per_device": 1,
+        "zero_optimization": {"stage": 2, "zenflow": {
+            "select_interval": "auto", "update_interval": "auto"}},
+    })
+    zf = cfg.zero_optimization.zenflow
+    assert zf.enabled and zf.select_interval == 100 and zf.update_interval == 4
+
+
 def test_config_top_level_zenflow_block():
     cfg = Config.from_dict({
         "train_micro_batch_size_per_device": 1,
