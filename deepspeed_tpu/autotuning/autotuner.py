@@ -1,12 +1,17 @@
-"""Autotuner: measured search over ZeRO stage x micro-batch x remat configs.
+"""Autotuner: measured search over stage x micro-batch x remat x offload x
+TP/SP x qgZ configs, with model-info-based pruning.
 
-Role parity with the reference ``autotuning/autotuner.py:42`` (``tune:404``:
-profile model, generate ZeRO-stage x micro-batch experiments, run each, pick
-the best by throughput ``run_tuning_micro_batch_sizes:741``). The reference
-schedules experiments across free cluster nodes via the launcher; on TPU a
-trial is a fresh in-process engine (jit-compiled, measured for a few steps), so
-the whole search runs where the job runs. OOMs and compile failures are caught
-and recorded as failed trials, exactly like the reference's experiment records.
+Role parity with the reference ``autotuning/autotuner.py:42`` (``tune:404``):
+the reference first PROFILES the model (param count -> per-stage memory
+estimates) to prune the search space, then generates ZeRO-stage x micro-batch
+experiments, runs each, and refines around the best
+(``run_tuning_micro_batch_sizes:741``). Same shape here: phase 1 prunes and
+sweeps stage x micro-batch; phase 2 refines the winner across the
+offload/TP/SP/qgZ dimensions. The reference schedules experiments across free
+cluster nodes via the launcher; on TPU a trial is a fresh in-process engine
+(jit-compiled, measured for a few steps), so the whole search runs where the
+job runs. OOMs and compile failures are caught and recorded as failed trials,
+exactly like the reference's experiment records.
 """
 
 from __future__ import annotations
@@ -20,6 +25,11 @@ from deepspeed_tpu.utils.logging import log_dist
 
 TUNING_METRICS = ("throughput", "latency")
 
+# fp32 master + Adam m/v = 12, fp32 grad accumulator = 4, bf16 compute cast
+# = 2 bytes/param on the fused path (matches bench.py's ladder sizing)
+_STATE_BYTES_PER_PARAM = 18.0
+_SHARDED_BYTES_PER_PARAM = 16.0  # the shardable share (master+opt+grads)
+
 
 @dataclass
 class TrialResult:
@@ -32,6 +42,63 @@ class TrialResult:
     def ok(self) -> bool:
         return self.error is None
 
+    @property
+    def skipped(self) -> bool:
+        return bool(self.error) and self.error.startswith("pruned:")
+
+
+@dataclass
+class ModelInfo:
+    """Reference ``model_info`` analog: what the pruner knows up front."""
+
+    num_params: int
+    hidden_size: int
+    num_layers: int
+
+    def state_bytes(self, stage: int, shards: int) -> float:
+        p = float(self.num_params)
+        if stage <= 0 or shards <= 1:
+            return p * _STATE_BYTES_PER_PARAM
+        # stages shard progressively more of the 18 bytes/param:
+        # 1: opt state (12), 2: + grads (16), 3: + the bf16 live params (18)
+        shardable = {1: 12.0, 2: 16.0, 3: 18.0}[min(stage, 3)]
+        resident = _STATE_BYTES_PER_PARAM - shardable
+        return p * (resident + shardable / shards)
+
+    def activation_bytes(self, micro_batch: int, seq_len: int) -> float:
+        # ~20 bf16 activation copies of [B, S, H] per layer without remat
+        # (attention + MLP intermediates); a deliberate overestimate the
+        # remat variant halves — pruning only needs the right order
+        return 2.0 * 20 * micro_batch * seq_len * self.hidden_size * self.num_layers
+
+
+def probe_model_info(model_builder, spec=None) -> ModelInfo:
+    """Build the spec once (no weights) and read its static facts."""
+    from deepspeed_tpu.models.api import ShardCtx
+
+    if spec is None:
+        spec = model_builder(ShardCtx()) if callable(model_builder) else model_builder
+    cfg = getattr(spec, "config", None)
+    return ModelInfo(
+        num_params=int(getattr(spec, "num_params", 0) or 0),
+        hidden_size=int(getattr(cfg, "hidden_size", 0) or 0),
+        num_layers=int(getattr(cfg, "num_layers", 1) or 1),
+    )
+
+
+def device_memory_bytes() -> float | None:
+    """Per-device memory when the backend reports it (TPU does; the CPU test
+    mesh does not -> no pruning)."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return float(stats["bytes_limit"])
+    except Exception:
+        pass
+    return None
+
 
 @dataclass
 class Autotuner:
@@ -43,35 +110,52 @@ class Autotuner:
     steps_per_trial: int = 3
     results: list = field(default_factory=list)
 
-    def _run_trial(self, overrides: dict, seq_len: int, vocab: int) -> TrialResult:
-        import deepspeed_tpu
-        from deepspeed_tpu.comm.topology import reset_topology
-
+    def _apply_overrides(self, overrides: dict) -> dict:
         cfg = dict(self.base_config)
         zero = dict(cfg.get("zero_optimization", {}))
         if "zero_stage" in overrides:
             zero["stage"] = overrides["zero_stage"]
+        if "offload" in overrides and overrides["offload"] != "none":
+            zero["offload_optimizer"] = {"device": overrides["offload"]}
+        if overrides.get("quantized_gradients"):
+            zero["quantized_gradients"] = True
         cfg["zero_optimization"] = zero
         if "micro_batch" in overrides:
             cfg["train_micro_batch_size_per_device"] = overrides["micro_batch"]
             cfg.pop("train_batch_size", None)
         if "remat" in overrides:
             cfg["activation_checkpointing"] = {"enabled": overrides["remat"]}
+        tp = overrides.get("tp", 1)
+        sp = overrides.get("sp", 1)
+        if tp > 1 or sp > 1:
+            mesh = dict(cfg.get("mesh", {}))
+            mesh.update({"data": -1, "tensor": tp, "sequence": sp})
+            cfg["mesh"] = mesh
         cfg["steps_per_print"] = 0
+        return cfg
 
+    def _run_trial(self, overrides: dict, seq_len: int, vocab: int) -> TrialResult:
+        import deepspeed_tpu
+        from deepspeed_tpu.comm.topology import reset_topology
+
+        cfg = self._apply_overrides(overrides)
         try:
             reset_topology()
             engine, _, _, _ = deepspeed_tpu.initialize(model=self.model_builder, config=cfg)
+            # trial timing must not bleed across the async dispatch window:
+            # settle every step (the production pipeline keeps _max_inflight)
+            engine._max_inflight = 0
             rng = np.random.default_rng(0)
 
             def batch():
                 return {"input_ids": rng.integers(
                     0, vocab, (engine.train_batch_size, seq_len), dtype=np.int32)}
 
-            engine.train_batch(batch())  # compile
+            float(engine.train_batch(batch()))  # compile + settle
             t0 = time.perf_counter()
             for _ in range(self.steps_per_trial):
-                engine.train_batch(batch())
+                loss = engine.train_batch(batch())
+            float(loss)  # settle before reading the clock
             dt = (time.perf_counter() - t0) / self.steps_per_trial
             return TrialResult(
                 overrides=overrides,
@@ -81,6 +165,15 @@ class Autotuner:
         except Exception as e:  # OOM / compile failure = failed experiment
             return TrialResult(overrides=overrides, error=f"{type(e).__name__}: {e}"[:300])
 
+    def _record(self, res: TrialResult) -> None:
+        self.results.append(res)
+        log_dist(
+            f"autotune {res.overrides}: "
+            + (f"{res.samples_per_sec:.1f} samples/s" if res.ok
+               else f"{'SKIPPED' if res.skipped else 'FAILED'} {res.error}"),
+            ranks=[0],
+        )
+
     def tune(
         self,
         micro_batch_sizes: list[int] = (1, 2, 4, 8),
@@ -88,34 +181,77 @@ class Autotuner:
         seq_len: int = 128,
         vocab: int = 1024,
         try_remat: bool = False,
+        offload_devices: list[str] = ("none",),
+        tp_degrees: list[int] = (1,),
+        sp_degrees: list[int] = (1,),
+        try_qgz: bool = False,
+        memory_bytes: float | None = None,
     ) -> dict:
-        """Grid search; returns the best override dict (reference ``tune:404``).
+        """Two-phase measured search; returns the best override dict
+        (reference ``tune:404``).
 
-        Like the reference's micro-batch sweep, larger micro batches are tried
-        until one fails (OOM), per stage."""
+        Phase 1: stage x micro-batch grid, pruned by the model-info memory
+        estimate when the device reports its memory (reference model-profile
+        pruning); larger micro batches per stage stop at the first OOM.
+        Phase 2: the offload/TP/SP/qgZ dimensions sweep AROUND the phase-1
+        winner (the reference's refinement loop) — each dimension varied
+        independently, best overall wins.
+        """
+        import jax
+
         self.results = []
+        info = probe_model_info(self.model_builder)
+        limit = memory_bytes if memory_bytes is not None else device_memory_bytes()
+        n_dev = len(jax.devices())
+
         for stage in zero_stages:
             for mb in micro_batch_sizes:
                 overrides = {"zero_stage": stage, "micro_batch": mb}
+                if limit and info.num_params:
+                    est = (info.state_bytes(stage, n_dev)
+                           + info.activation_bytes(mb, seq_len))
+                    if est > 0.9 * limit:
+                        self._record(TrialResult(
+                            overrides=overrides,
+                            error=f"pruned: est {est/1e9:.1f} GB > "
+                                  f"0.9 x {limit/1e9:.1f} GB"))
+                        continue
                 variants = [dict(overrides)]
                 if try_remat:
                     variants.append({**overrides, "remat": True})
                 oomed = False
                 for ov in variants:
                     res = self._run_trial(ov, seq_len, vocab)
-                    self.results.append(res)
-                    log_dist(
-                        f"autotune {ov}: "
-                        + (f"{res.samples_per_sec:.1f} samples/s" if res.ok else f"FAILED {res.error}"),
-                        ranks=[0],
-                    )
+                    self._record(res)
                     if not res.ok and "Resource" in (res.error or ""):
                         oomed = True
                 if oomed:
                     break  # bigger micro batches will OOM too
+
         good = [r for r in self.results if r.ok]
         if not good:
             raise RuntimeError("autotuning: every trial failed")
+        best = (max(good, key=lambda r: r.samples_per_sec)
+                if self.metric == "throughput" else min(good, key=lambda r: r.step_ms))
+
+        # phase 2: refine the winner along the remaining dimensions
+        refinements: list[dict] = []
+        for dev in offload_devices:
+            if dev != "none":
+                refinements.append({**best.overrides, "offload": dev})
+        for tp in tp_degrees:
+            if tp > 1 and n_dev % tp == 0:
+                refinements.append({**best.overrides, "tp": tp})
+        for sp in sp_degrees:
+            if sp > 1 and n_dev % sp == 0 and seq_len % sp == 0:
+                refinements.append({**best.overrides, "sp": sp})
+        if try_qgz and best.overrides.get("zero_stage", 0) >= 1:
+            refinements.append({**best.overrides, "quantized_gradients": True})
+        for ov in refinements:
+            res = self._run_trial(ov, seq_len, vocab)
+            self._record(res)
+
+        good = [r for r in self.results if r.ok]
         best = (max(good, key=lambda r: r.samples_per_sec)
                 if self.metric == "throughput" else min(good, key=lambda r: r.step_ms))
         log_dist(f"autotune best: {best.overrides} ({best.samples_per_sec:.1f} samples/s)",

@@ -327,6 +327,11 @@ class SequenceParallelConfig(ConfigBase):
     """Ulysses / ring attention (reference: ``deepspeed/sequence/``)."""
 
     mode: str = "ulysses"  # ulysses | ring
+    # AutoSP (reference sequence/auto_sp.py): patch the standard attention
+    # entry point (jax.nn.dot_product_attention) during tracing so user
+    # models not written against ShardCtx get sequence parallelism
+    # automatically (parallel/auto_sp.py)
+    auto: bool = False
     tiled_mlp: bool = False
     tiled_logits: bool = False
     tile_size: int = 1024  # sequence tokens per ALST compute tile

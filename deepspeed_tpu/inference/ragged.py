@@ -86,6 +86,11 @@ class RaggedConfig:
     # the difference between dispatch-latency-bound and compute-bound decode
     # on remote/tunneled accelerators. 0 disables.
     decode_run_ahead: int = 0
+    # tiled prefill: lay prefill chunks at tile-aligned offsets so the tiled
+    # Pallas kernel fetches each KV block once per TILE instead of once per
+    # token (ops/pallas ragged_prefill_attention — the SplitFuse blocked
+    # flash attention). 0 disables (per-token kernel for everything).
+    prefill_tile: int = 0
 
     @property
     def max_seq_len(self) -> int:
@@ -188,6 +193,22 @@ class RaggedInferenceEngine:
         self._buckets.append(self.cfg.max_tokens_per_step)
         self._step_jit = self._build_step()
         self._chunk_jit = None  # decode run-ahead program (lazy)
+        self._use_tiles = self.cfg.prefill_tile > 0
+        if self._use_tiles and not self.spec.supports_prefill_tiles:
+            raise ValueError(
+                f"prefill_tile={self.cfg.prefill_tile} but model "
+                f"{self.spec.name} does not accept prefill_tiles (its "
+                "ragged_forward has no tiled path); it would silently no-op")
+        if self._use_tiles and self.cfg.prefill_tile > self.cfg.max_tokens_per_step:
+            raise ValueError("prefill_tile exceeds max_tokens_per_step")
+        self._tiled_jits: dict = {}
+        # decode-region buckets for the tiled path (decodes <= max_seqs)
+        self._dec_buckets = []
+        b = 4
+        while b < self.cfg.max_seqs:
+            self._dec_buckets.append(b)
+            b *= 2
+        self._dec_buckets.append(self.cfg.max_seqs)
         # scheduling efficiency telemetry (padding fraction; comparable to the
         # dense engine's pad-to-max waste)
         self.tokens_scheduled = 0
@@ -312,6 +333,10 @@ class RaggedInferenceEngine:
             k -= 1  # pool pressure: partial growth is kept, retry smaller
         if k < 2:
             return None
+        # round k DOWN to a power of two: jit specializes per (k, batch), and
+        # arbitrary residuals (47, 45, 31, ...) would each compile a fresh
+        # K-step scan — the bucketing discipline every other dimension uses
+        k = 1 << (k.bit_length() - 1)
         t = len(seqs)
         bucket = next(b for b in self._buckets if b >= t)
         tokens = np.zeros(bucket, np.int32)
@@ -344,23 +369,11 @@ class RaggedInferenceEngine:
                 self._release(s)
         return emit
 
-    def step(self) -> dict:
-        """One SplitFuse step. Returns {uid: token} for sequences that emitted
-        a token this step (under decode run-ahead: the LAST token of each
-        sequence's chunk; the full stream is in the per-sequence state)."""
-        if not self.has_work:
-            return {}
-        ahead = self._try_decode_run_ahead()
-        if ahead is not None:
-            return ahead
-        budget = self.cfg.max_tokens_per_step
-        tokens = np.zeros(budget, np.int32)
-        slots = np.full(budget, self.cfg.max_seqs, np.int32)  # padding row
-        positions = np.zeros(budget, np.int32)
-        emit: list[tuple[int, _SeqState]] = []
+    def _schedule_decodes(self, budget: int, tokens, slots, positions,
+                          emit) -> int:
+        """Pass 1: ongoing decodes first (latency priority, FastGen policy).
+        Writes into the arrays from index 0, returns the count."""
         n = 0
-
-        # 1) ongoing decodes first (latency priority, FastGen policy)
         for seq in list(self._running.values()):
             if not seq.in_decode or n >= budget:
                 continue
@@ -372,11 +385,13 @@ class RaggedInferenceEngine:
             emit.append((n, seq))
             seq.pos += 1
             n += 1
+        return n
 
-        # 2) admit queued requests while slots + budget remain (their prompt
-        #    chunks are scheduled in pass 3 below); admission reserves the
-        #    request's worst-case block count so admitted work always finishes
-        while self._queued and self._free_slots and n < budget:
+    def _admit_queued(self) -> None:
+        """Pass 2: admit queued requests while slots remain (their prompt
+        chunks are scheduled by pass 3); admission reserves the request's
+        worst-case block count so admitted work always finishes."""
+        while self._queued and self._free_slots:
             seq = self._queued[0]
             worst = self._worst_case_blocks(seq)
             if worst > self.allocator.free_blocks - self._reserved:
@@ -386,6 +401,38 @@ class RaggedInferenceEngine:
             seq.reserved_remaining = worst
             self._reserved += worst
             self._running[seq.slot] = seq
+
+    def _deadlock_guard(self, n: int) -> None:
+        if n == 0:
+            # has_work but nothing schedulable: every sequence is stalled on
+            # KV-pool capacity and nothing can ever free a block — a silent
+            # livelock without this guard. (The reference avoids this state
+            # with conservative admission; we surface it instead.)
+            raise RuntimeError(
+                "KV pool deadlock: all sequences stalled waiting for blocks "
+                f"({self.allocator.free_blocks} free of "
+                f"{self.cfg.num_blocks - 1} usable); enlarge num_blocks or "
+                "lower max_seqs/max_new_tokens"
+            )
+
+    def step(self) -> dict:
+        """One SplitFuse step. Returns {uid: token} for sequences that emitted
+        a token this step (under decode run-ahead: the LAST token of each
+        sequence's chunk; the full stream is in the per-sequence state)."""
+        if not self.has_work:
+            return {}
+        ahead = self._try_decode_run_ahead()
+        if ahead is not None:
+            return ahead
+        if self._use_tiles:
+            return self._step_tiled()
+        budget = self.cfg.max_tokens_per_step
+        tokens = np.zeros(budget, np.int32)
+        slots = np.full(budget, self.cfg.max_seqs, np.int32)  # padding row
+        positions = np.zeros(budget, np.int32)
+        emit: list[tuple[int, _SeqState]] = []
+        n = self._schedule_decodes(budget, tokens, slots, positions, emit)
+        self._admit_queued()
 
         # 3) prefill chunks for running prompts within the remaining budget
         for seq in list(self._running.values()):
@@ -405,17 +452,7 @@ class RaggedInferenceEngine:
             if seq.pos == len(seq.prompt):
                 emit.append((n - 1, seq))  # last prompt token -> first new token
 
-        if n == 0:
-            # has_work but nothing schedulable: every sequence is stalled on
-            # KV-pool capacity and nothing can ever free a block — a silent
-            # livelock without this guard. (The reference avoids this state
-            # with conservative admission; we surface it instead.)
-            raise RuntimeError(
-                "KV pool deadlock: all sequences stalled waiting for blocks "
-                f"({self.allocator.free_blocks} free of "
-                f"{self.cfg.num_blocks - 1} usable); enlarge num_blocks or "
-                "lower max_seqs/max_new_tokens"
-            )
+        self._deadlock_guard(n)
         bucket = next(b for b in self._buckets if b >= n)
         self.tokens_scheduled += n
         self.tokens_padded += bucket - n
@@ -424,6 +461,109 @@ class RaggedInferenceEngine:
             self.params, self.cache,
             jnp.asarray(tokens[:bucket]), jnp.asarray(slots[:bucket]),
             jnp.asarray(positions[:bucket]),
+            jnp.asarray(self.block_tables),
+        )
+        out: dict = {}
+        if emit:
+            idx = np.asarray([i for i, _ in emit])
+            picked = np.asarray(jnp.argmax(logits[idx].astype(jnp.float32), axis=-1))
+            for (_, seq), tok in zip(emit, picked):
+                seq.generated.append(int(tok))
+                out[seq.uid] = int(tok)
+                if seq.finished:
+                    self._release(seq)
+        return out
+
+    def _get_tiled_step(self, nd: int, nt: int):
+        """Jitted step with a static (decode-count, tile-count) split; one
+        program per bucket pair."""
+        key = (nd, nt)
+        if key not in self._tiled_jits:
+            fwd = self.spec.ragged_forward_fn
+            ct = self.cfg.prefill_tile
+
+            def step_fn(params, cache, tokens, slots, positions, ts, tp, tv, bt):
+                return fwd(params, tokens, slots, positions, bt, cache,
+                           prefill_tiles=(nd, ts, tp, tv, ct))
+
+            self._tiled_jits[key] = jax.jit(step_fn, donate_argnums=(1,))
+        return self._tiled_jits[key]
+
+    def _step_tiled(self) -> dict:
+        """One SplitFuse step with tile-aligned prefill layout: tokens
+        [0, ND) are decodes (bucketed), the rest are prefill chunks laid at
+        tile boundaries so the tiled kernel fetches each KV block once per
+        tile (see RaggedConfig.prefill_tile)."""
+        ct = self.cfg.prefill_tile
+        budget = self.cfg.max_tokens_per_step
+        tokens = np.zeros(budget + ct, np.int32)
+        slots = np.full(budget + ct, self.cfg.max_seqs, np.int32)
+        positions = np.zeros(budget + ct, np.int32)
+        emit: list[tuple[int, _SeqState]] = []
+        n_dec = self._schedule_decodes(min(budget, self.cfg.max_seqs),
+                                       tokens, slots, positions, emit)
+        self._admit_queued()
+        nd = 0 if n_dec == 0 else next(b for b in self._dec_buckets
+                                       if b >= n_dec)
+
+        # prefill chunks at tile-aligned offsets after the decode region
+        ntiles_cap = max(0, (budget - nd) // ct)
+        chunks: list[tuple[_SeqState, int, int]] = []  # (seq, rel_tile0, take)
+        tiles_used = 0
+        sched = 0
+        for seq in list(self._running.values()):
+            if seq.in_decode or tiles_used >= ntiles_cap:
+                continue
+            avail = (ntiles_cap - tiles_used) * ct
+            take = min(avail, len(seq.prompt) - seq.pos)
+            while take and not self._ensure_capacity(seq, seq.pos + take):
+                take -= 1  # partial chunk under pool pressure
+            if take <= 0:
+                continue
+            start = nd + tiles_used * ct
+            tokens[start:start + take] = seq.prompt[seq.pos:seq.pos + take]
+            slots[start:start + take] = seq.slot
+            positions[start:start + take] = np.arange(
+                seq.pos, seq.pos + take, dtype=np.int32)
+            chunks.append((seq, tiles_used, take))
+            seq.pos += take
+            sched += take
+            tiles_used += -(-take // ct)
+            if seq.pos == len(seq.prompt):
+                emit.append((start + take - 1, seq))
+        self._deadlock_guard(n_dec + sched)
+
+        if tiles_used == 0:
+            nt = 0
+        else:
+            nt = 1
+            while nt < tiles_used:
+                nt *= 2
+            nt = min(nt, max(1, ntiles_cap))
+            if nt < tiles_used:  # cap can be non-power-of-2
+                nt = tiles_used
+        total = nd + nt * ct
+        # per-tile metadata (pad tiles: scratch row, valid=0)
+        ts = np.full(max(nt, 1), self.cfg.max_seqs, np.int32)
+        tp = np.zeros(max(nt, 1), np.int32)
+        tv = np.zeros(max(nt, 1), np.int32)
+        for seq, tile0, take in chunks:
+            pos0 = positions[nd + tile0 * ct]
+            for t in range(-(-take // ct)):
+                ts[tile0 + t] = seq.slot
+                tp[tile0 + t] = pos0 + t * ct
+                tv[tile0 + t] = min(ct, take - t * ct)
+
+        self.tokens_scheduled += n_dec + sched
+        self.tokens_padded += total - n_dec - sched
+
+        step_fn = self._get_tiled_step(nd, nt)
+        logits, self.cache = step_fn(
+            self.params, self.cache,
+            jnp.asarray(tokens[:total]), jnp.asarray(slots[:total]),
+            jnp.asarray(positions[:total]),
+            jnp.asarray(ts[:max(nt, 1)]), jnp.asarray(tp[:max(nt, 1)]),
+            jnp.asarray(tv[:max(nt, 1)]),
             jnp.asarray(self.block_tables),
         )
         out: dict = {}

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import shlex
 import subprocess
 import sys
 
@@ -79,19 +78,6 @@ def propagate_env() -> dict[str, str]:
                         k, v = line.split("=", 1)
                         env[k] = v
     return env
-
-
-def build_node_cmd(script: str, script_args: list[str], coordinator: str,
-                   num_processes: int, process_id: int, extra_env: dict) -> str:
-    env = {
-        "DSTPU_COORDINATOR": coordinator,
-        "DSTPU_NUM_PROCESSES": str(num_processes),
-        "DSTPU_PROCESS_ID": str(process_id),
-        **extra_env,
-    }
-    exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
-    args = " ".join(shlex.quote(a) for a in script_args)
-    return f"{exports} cd {shlex.quote(os.getcwd())}; {sys.executable} {shlex.quote(script)} {args}"
 
 
 def build_runner(args, extra_env: dict[str, str]):

@@ -244,6 +244,9 @@ class ModelSpec:
     #   -> (logits [T, V], cache)
     init_paged_cache_fn: Callable | None = None
     ragged_forward_fn: Callable | None = None
+    # ragged_forward_fn accepts prefill_tiles=(n_dec, tile_slot, tile_pos0,
+    # tile_valid, tile) for the tiled-prefill fast path (SplitFuse kernel)
+    supports_prefill_tiles: bool = False
     # 1F1B pipeline decomposition (parallel/pipeline_1f1b.py): the tuple
     # (stage0_fn, block_fn, last_fn, split_fn, merge_fn) itself
     pipeline_parts: Any = None

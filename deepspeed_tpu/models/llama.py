@@ -268,13 +268,18 @@ def init_paged_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots, block_tables):
+def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots,
+                  block_tables, prefill_tiles=None):
     """One decoder layer over a flat ragged token batch.
 
     ``x`` [T, D] mixes prefill-chunk tokens and decode tokens from different
     sequences (SplitFuse layout, reference ``inference/v2/ragged``). New KV is
     scattered into the block pool *before* attention, so intra-chunk causal
     attention falls out of the position mask with no special casing.
+
+    ``prefill_tiles``: optional ``(n_dec, tile_slot, tile_pos0, tile_valid,
+    tile)`` — tokens [0, n_dec) are decodes (per-token kernel), the rest are
+    tile-aligned prefill chunks (tiled kernel: one KV-block fetch per tile).
     """
     lp = _dq_layer(lp, x.dtype)
     t_tokens, d = x.shape
@@ -294,11 +299,26 @@ def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots, block_table
     kc = kc.at[blk, off].set(kk.astype(kc.dtype))
     vc = vc.at[blk, off].set(vv.astype(vc.dtype))
 
-    # paged attention over the blocked pool: Pallas block-table kernel on
-    # TPU, padded-gather XLA fallback (ops/attention.paged_attention)
-    from deepspeed_tpu.ops.attention import paged_attention
+    # paged attention over the blocked pool: Pallas block-table kernels on
+    # TPU, padded-gather XLA fallback (ops/attention)
+    from deepspeed_tpu.ops.attention import (
+        paged_attention,
+        ragged_prefill_attention,
+    )
 
-    o = paged_attention(q, kc, vc, slots, positions, block_tables).astype(x.dtype)
+    if prefill_tiles is None:
+        o = paged_attention(q, kc, vc, slots, positions, block_tables)
+    else:
+        n_dec, ts, tp, tv, ct = prefill_tiles
+        parts = []
+        if n_dec:
+            parts.append(paged_attention(q[:n_dec], kc, vc, slots[:n_dec],
+                                         positions[:n_dec], block_tables))
+        if t_tokens > n_dec:
+            parts.append(ragged_prefill_attention(
+                q[n_dec:], kc, vc, ts, tp, tv, block_tables, ct))
+        o = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    o = o.astype(x.dtype)
     x = x + o.reshape(t_tokens, hq * hd) @ lp["wo"]
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -307,21 +327,23 @@ def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots, block_table
 
 
 def ragged_forward(cfg: LlamaConfig, params, tokens, slots, positions,
-                   block_tables, cache):
+                   block_tables, cache, prefill_tiles=None):
     """Flat ragged step: ``[T]`` mixed tokens -> (``[T, V]`` logits, cache).
 
     Each token carries (slot, absolute position); ``block_tables``
     [max_seqs+1, max_blocks] maps slots to KV pool blocks (row ``max_seqs`` is
     the all-scratch padding row). One static-shape XLA program serves any mix
     of prefill chunks and decodes (reference ``inference/v2/engine_v2.py:30``
-    ``put()`` + ``ragged_ops`` kernels).
+    ``put()`` + ``ragged_ops`` kernels). ``prefill_tiles``: see
+    ``_ragged_layer`` (tiled-prefill fast path).
     """
     # plain gather (see decode_forward's note: replication is a training fix)
     x = params["embed"][tokens].astype(cache["k"].dtype)
 
     def body(x, lp_kv):
         lp, kc, vc = lp_kv
-        x, kc, vc = _ragged_layer(cfg, x, lp, kc, vc, positions, slots, block_tables)
+        x, kc, vc = _ragged_layer(cfg, x, lp, kc, vc, positions, slots,
+                                  block_tables, prefill_tiles=prefill_tiles)
         return x, (kc, vc)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
@@ -428,6 +450,7 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         decode_fn=partial(decode_forward, cfg, ctx=ctx),
         init_paged_cache_fn=partial(init_paged_cache, cfg),
         ragged_forward_fn=partial(ragged_forward, cfg),
+        supports_prefill_tiles=True,
         pipeline_parts=pipeline_parts(cfg, ctx=ctx, attn_impl=attn_impl),
         supports_pld=True,
     )

@@ -156,6 +156,42 @@ def paged_attention(q, k_pool, v_pool, slots, positions, block_tables,
                       ).astype(q.dtype)
 
 
+def ragged_prefill_attention(q, k_pool, v_pool, tile_slot, tile_pos0,
+                             tile_valid, block_tables, tile: int,
+                             scale: float | None = None, impl: str = "auto"):
+    """Tiled prefill attention over the blocked pool: ``q`` holds tile-aligned
+    prefill tokens (one sequence per CT-token tile, consecutive positions,
+    rows past ``tile_valid`` padding). The Pallas kernel fetches each KV block
+    ONCE per tile instead of once per token
+    (``ops/pallas/paged_attention.ragged_prefill_attention``); the XLA
+    fallback expands the tile metadata to per-token (slot, position) arrays
+    and reuses the padded-gather path.
+    """
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "xla"
+    if impl == "pallas":
+        try:
+            from deepspeed_tpu.ops.pallas.paged_attention import (
+                ragged_prefill_attention as _pallas_prefill,
+            )
+
+            return _pallas_prefill(q, k_pool, v_pool, tile_slot, tile_pos0,
+                                   tile_valid, block_tables, tile, scale=scale)
+        except (ImportError, NotImplementedError):
+            impl = "xla"
+    if impl != "xla":
+        raise ValueError(f"unknown prefill attention impl {impl!r}")
+    t = q.shape[0]
+    c = jnp.arange(t) // tile
+    i = jnp.arange(t) % tile
+    pad_row = block_tables.shape[0] - 1  # all-scratch padding row
+    valid = i < tile_valid[c]
+    slots = jnp.where(valid, tile_slot[c], pad_row).astype(jnp.int32)
+    positions = jnp.where(valid, tile_pos0[c] + i, 0).astype(jnp.int32)
+    return paged_attention(q, k_pool, v_pool, slots, positions, block_tables,
+                           scale=scale, impl="xla")
+
+
 @functools.partial(jax.jit, static_argnums=(3,))
 def apply_rope(q, k, positions, theta: float = 10000.0):
     """Rotary position embedding (reference: ``apply_rotary_pos_emb`` kernels,

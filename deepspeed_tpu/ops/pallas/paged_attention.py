@@ -1,4 +1,4 @@
-"""Pallas paged flash-decode attention over the blocked KV pool.
+"""Pallas paged attention over the blocked KV pool: flash-decode + tiled prefill.
 
 Role parity with the reference's ragged kernels
 (``inference/v2/kernels/ragged_ops/`` blocked flash attention +
@@ -129,3 +129,119 @@ def paged_decode_attention(q, k_pool, v_pool, slots, positions, block_tables,
         interpret=jax.default_backend() != "tpu",
     )(slots.astype(jnp.int32), positions.astype(jnp.int32),
       block_tables.astype(jnp.int32), q, k_pool, v_pool)
+
+
+# --------------------------------------------------------------- tiled prefill
+def _prefill_kernel(ts_ref, tp_ref, tv_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                    acc, m_sc, l_sc, *, bs: int, ct: int, rep: int, scale: float):
+    c = pl.program_id(0)   # query tile
+    j = pl.program_id(1)   # kv block ordinal
+    nj = pl.num_programs(1)
+    pos0 = tp_ref[c]
+    valid = tv_ref[c]
+    max_pos = pos0 + valid - 1
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    @pl.when(jnp.logical_and(valid > 0, j * bs <= max_pos))
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * scale        # [CT, Hq, D]
+        k = k_ref[0].astype(jnp.float32)                  # [BS, Hkv, D]
+        v = v_ref[0].astype(jnp.float32)
+        hq, d = q.shape[1], q.shape[2]
+        hkv = k.shape[1]
+        # GQA layout: [Hkv, CT*rep, D]; row r -> query token i = r // rep
+        qg = q.reshape(ct, hkv, rep, d).transpose(1, 0, 2, 3).reshape(
+            hkv, ct * rep, d)
+        s = jax.lax.dot_general(
+            qg, k.transpose(1, 2, 0),                     # [Hkv, D, BS]
+            (((2,), (1,)), ((0,), (0,))),
+        )                                                 # [Hkv, CT*rep, BS]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (1, ct * rep, 1), 1) // rep
+        qpos = pos0 + qi
+        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bs), 2)
+        mask = jnp.logical_and(kpos <= qpos, qi < valid)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)        # [Hkv, CT*rep, 1]
+        m_prev = m_sc[:, :, :1]
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)
+        # fully-masked rows (pad queries / no visible keys in this block)
+        # produce exp(-inf - -inf); zero them rather than poison l
+        p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_sc[:, :, :1] = l_sc[:, :, :1] * corr + jnp.sum(p, -1, keepdims=True)
+        m_sc[:, :, :1] = m_new
+        pv = jax.lax.dot_general(
+            p, v.transpose(1, 0, 2),                      # [Hkv, BS, D]
+            (((2,), (1,)), ((0,), (0,))),
+        )                                                 # [Hkv, CT*rep, D]
+        acc[:] = acc[:] * corr + pv
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        hkv = acc.shape[0]
+        d = acc.shape[2]
+        out = acc[:] / jnp.maximum(l_sc[:, :, :1], 1e-30)
+        o_ref[...] = out.reshape(hkv, ct, rep, d).transpose(1, 0, 2, 3).reshape(
+            ct, hkv * rep, d).astype(o_ref.dtype)
+
+
+def ragged_prefill_attention(q, k_pool, v_pool, tile_slot, tile_pos0,
+                             tile_valid, block_tables, tile: int,
+                             scale: float | None = None):
+    """Tiled prefill attention: [NT*CT, Hq, D] tile-aligned prefill tokens ->
+    outputs, one KV-block DMA shared by the whole CT-token tile (the
+    SplitFuse blocked flash attention, reference
+    ``inference/v2/kernels/ragged_ops`` — vs the decode kernel above, which
+    fetches per TOKEN and is O(context) DMA per token).
+
+    Scheduler contract (``inference/ragged.py``): each tile's tokens belong
+    to ONE sequence at consecutive positions ``pos0..pos0+valid-1``; rows
+    past ``valid`` are padding. ``tile_valid == 0`` marks an all-pad tile.
+    """
+    if pltpu is None:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    t_tokens, hq, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    rep = hq // hkv
+    ct = tile
+    n_tiles = t_tokens // ct
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    # clamp past the tile's last needed block: unchanged id -> no new DMA
+    def _kv_map(c, j, ts, tp, tv, bt):
+        last = jnp.maximum(tp[c] + tv[c] - 1, 0) // bs
+        return (bt[ts[c], jnp.minimum(j, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(n_tiles, mb),
+        in_specs=[
+            pl.BlockSpec((ct, hq, d), lambda c, j, ts, tp, tv, bt: (c, 0, 0)),
+            pl.BlockSpec((1, bs, hkv, d), _kv_map),
+            pl.BlockSpec((1, bs, hkv, d), _kv_map),
+        ],
+        out_specs=pl.BlockSpec((ct, hq, d),
+                               lambda c, j, ts, tp, tv, bt: (c, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, ct * rep, d), jnp.float32),
+            pltpu.VMEM((hkv, ct * rep, 128), jnp.float32),
+            pltpu.VMEM((hkv, ct * rep, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_prefill_kernel, bs=bs, ct=ct, rep=rep,
+                               scale=scale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((t_tokens, hq, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=jax.default_backend() != "tpu",
+    )(tile_slot.astype(jnp.int32), tile_pos0.astype(jnp.int32),
+      tile_valid.astype(jnp.int32), block_tables.astype(jnp.int32),
+      q, k_pool, v_pool)

@@ -1,0 +1,99 @@
+"""AutoSP: automatic sequence-parallel insertion for user models not written
+against ``ShardCtx``.
+
+Role parity with the reference AutoSP (``deepspeed/sequence/auto_sp.py`` +
+``compile/passes/sp_compile.py``): the reference detects
+``F.scaled_dot_product_attention`` calls in the torch.compile FX graph
+(``autosp_detector.py``) and rewrites them with sequence-parallel
+all-to-alls. The JAX analog of "the graph's standard attention entry point"
+is ``jax.nn.dot_product_attention``: while an :class:`auto_sp` context is
+active (the engine holds it open during tracing when
+``sequence_parallel.auto`` is set), calls to it are routed through Ulysses
+(or ring) attention over the mesh's ``sequence`` axis — the user's model code
+is untouched, exactly the reference's promise.
+
+Hand-rolled attention math (explicit softmax(QK^T)V) is NOT detected — the
+same limitation as the reference, whose detector also only matches the sdpa
+call. Such models should call ``parallel.ulysses.ulysses_attention``
+directly, or be written against ``ShardCtx.attention``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from deepspeed_tpu.comm.topology import AXIS_SEQ
+from deepspeed_tpu.utils.logging import logger
+
+_WARNED = False
+
+
+class auto_sp:
+    """Context manager patching ``jax.nn.dot_product_attention`` to run
+    sequence-parallel over ``mesh``. Active only inside the ``with`` block —
+    hold it open around model tracing (the engine does this when
+    ``sequence_parallel.auto`` is on)."""
+
+    def __init__(self, mesh, mode: str = "ulysses"):
+        if mode not in ("ulysses", "ring"):
+            raise ValueError(f"auto_sp mode must be ulysses|ring, got {mode!r}")
+        self.mesh = mesh
+        self.mode = mode
+        self._orig = None
+
+    def _wrapped(self, orig):
+        mesh, mode = self.mesh, self.mode
+
+        def dot_product_attention(query, key, value, bias=None, mask=None,
+                                  *args, is_causal: bool = False, **kwargs):
+            global _WARNED
+            sp = mesh.shape.get(AXIS_SEQ, 1) if mesh is not None else 1
+            if sp <= 1:
+                return orig(query, key, value, bias, mask, *args,
+                            is_causal=is_causal, **kwargs)
+            if bias is not None or mask is not None:
+                # a seq-sharded bias/mask would need resharding alongside the
+                # activations; fall back loudly rather than compute nonsense
+                if not _WARNED:
+                    _WARNED = True
+                    logger.warning(
+                        "auto_sp: dot_product_attention called with "
+                        "bias/mask — not sequence-parallelized (gathered "
+                        "attention instead)")
+                return orig(query, key, value, bias, mask, *args,
+                            is_causal=is_causal, **kwargs)
+            if mode == "ring":
+                from deepspeed_tpu.parallel.ring_attention import ring_attention
+
+                return ring_attention(query, key, value, mesh,
+                                      causal=is_causal)
+            from deepspeed_tpu.parallel.ulysses import ulysses_attention
+
+            local = lambda q, k, v: orig(  # noqa: E731
+                q, k, v, None, None, *args, is_causal=is_causal, **kwargs)
+            return ulysses_attention(query, key, value, mesh,
+                                     causal=is_causal, local_fn=local)
+
+        return dot_product_attention
+
+    def __enter__(self):
+        self._orig = jax.nn.dot_product_attention
+        jax.nn.dot_product_attention = self._wrapped(self._orig)
+        return self
+
+    def __exit__(self, *exc):
+        jax.nn.dot_product_attention = self._orig
+        self._orig = None
+        return False
+
+
+def wrap_loss_fn(loss_fn, mesh, mode: str = "ulysses"):
+    """Wrap a ModelSpec loss/forward fn so the AutoSP patch is active
+    whenever it is traced (the engine applies this under
+    ``sequence_parallel.auto``)."""
+
+    def wrapped(*args, **kwargs):
+        with auto_sp(mesh, mode):
+            return loss_fn(*args, **kwargs)
+
+    return wrapped

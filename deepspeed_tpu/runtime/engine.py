@@ -107,6 +107,19 @@ class Engine:
         self.model_spec = model(self.shard_ctx) if callable(model) else model
         self.training_dataloader = training_data
 
+        # AutoSP (reference sequence/auto_sp.py): models NOT written against
+        # ShardCtx get sequence parallelism by patching the standard
+        # attention entry point during tracing (parallel/auto_sp.py)
+        if sp_cfg.auto and topo.size("sequence") > 1:
+            from deepspeed_tpu.parallel.auto_sp import wrap_loss_fn
+
+            self.model_spec.loss_fn = wrap_loss_fn(
+                self.model_spec.loss_fn, topo.mesh, sp_cfg.mode)
+            self.model_spec.forward_fn = wrap_loss_fn(
+                self.model_spec.forward_fn, topo.mesh, sp_cfg.mode)
+            log_dist("auto_sp: jax.nn.dot_product_attention routed through "
+                     f"{sp_cfg.mode} sequence parallelism", ranks=[0])
+
         zero = config.zero_optimization
         self.zero_stage = zero.stage
         self.plan: ShardingPlan = plan_sharding(
@@ -803,8 +816,26 @@ class Engine:
             self._train_rng, dev_batch,
         )
         cfg = self.config
-        denom = self.scale_state.scale * jnp.float32(self.gas)
-        gnorm = _global_norm(grad_sum) / denom
+        # ONE fused program for the step prologue (norm + overflow + clip +
+        # lr). Eager per-leaf jnp ops here would each dispatch a tiny
+        # 8-device program with its own collective rendezvous — racing the
+        # AIO threads, that starves nondeterministically on a 1-core host
+        # (observed as 0%-CPU wedges in the test suite).
+        if getattr(self, "_nvme_pre_jit", None) is None:
+            gas = jnp.float32(self.gas)
+            clip = cfg.gradient_clipping
+
+            def pre_fn(grad_sum, scale, step):
+                denom = scale * gas
+                gnorm = _global_norm(grad_sum) / denom
+                finite = precision.grads_finite(grad_sum)
+                coef = (jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                        if clip > 0 else jnp.float32(1.0))
+                return gnorm, finite, coef / denom, self.lr_schedule(step)
+
+            self._nvme_pre_jit = jax.jit(pre_fn)
+        gnorm, finite_dev, factor, lr = self._nvme_pre_jit(
+            grad_sum, self.scale_state.scale, jnp.int32(self.global_steps))
         speculative = cfg.zero_optimization.offload_optimizer.super_offload
         if speculative:
             # SuperOffload speculative step (reference
@@ -813,16 +844,9 @@ class Engine:
             # finite predicate stays a device scalar and gates the writes
             # inside the jitted apply, so an overflowed step writes back
             # unchanged state instead of rolling back a mutated one
-            finite_dev = precision.grads_finite(grad_sum)
             run_walk = True
         else:
-            finite_dev = jnp.asarray(bool(precision.grads_finite(grad_sum)))
             run_walk = bool(finite_dev)
-        coef = jnp.float32(1.0)
-        if cfg.gradient_clipping > 0:
-            coef = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
-        factor = coef / denom
-        lr = self.lr_schedule(jnp.int32(self.global_steps))
 
         if run_walk:
             p_leaves = jax.tree_util.tree_leaves(self.params)
@@ -853,13 +877,14 @@ class Engine:
             self.params = jax.tree_util.tree_unflatten(
                 self._param_treedef, new_p_leaves)
             self._swapper.commit()
+        step_scale = self.scale_state.scale  # the scale THIS step ran at
         self.scale_state = precision.update_loss_scale(
             self.scale_state, finite_dev, cfg.fp16)
         metrics = {
             "loss": loss,
             "grad_norm": gnorm,
             "lr": lr,
-            "loss_scale": denom / self.gas,
+            "loss_scale": step_scale,
             "skipped": jnp.logical_not(finite_dev),
         }
         self.tput_timer.stop(global_step=True)

@@ -65,6 +65,19 @@ class HybridEngine(Engine):
         self._eval_step = -1
 
     # ------------------------------------------------------------- eval cast
+    def invalidate_eval_cache(self) -> None:
+        """Drop the cached inference-dtype cast (anything that replaces
+        ``self.params`` outside ``train_batch`` must call this)."""
+        self._eval_params = None
+        self._eval_step = -1
+
+    def load_checkpoint(self, *args, **kwargs):
+        # the restored global_steps can equal the cached cast's step stamp,
+        # which would silently serve rollouts from the PRE-load weights
+        out = super().load_checkpoint(*args, **kwargs)
+        self.invalidate_eval_cache()
+        return out
+
     @property
     def eval_params(self):
         """Inference-dtype view of the CURRENT weights, cast once per

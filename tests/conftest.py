@@ -28,7 +28,10 @@ _flags += "".join(
     for f in probe_extra_xla_flags(
         [
             "--xla_cpu_collective_call_warn_stuck_seconds=120",
-            "--xla_cpu_collective_call_terminate_timeout_seconds=3600",
+            # a wedged collective must FAIL loudly (surfacing the emulation
+            # artifact, see tests/unit/isolation.py) instead of eating the
+            # whole suite window as a silent 0%-CPU hang
+            "--xla_cpu_collective_call_terminate_timeout_seconds=600",
         ],
         base_flags=_flags,
     )
@@ -50,14 +53,86 @@ try:
 except Exception:
     pass
 
-# persistent compilation cache: repeat runs of the suite skip XLA recompiles
-# (the dominant cost — every engine test jits a full train step)
-_cache_dir = os.environ.get("DSTPU_TEST_JIT_CACHE", "/tmp/dstpu_jit_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# NO persistent compilation cache for the CPU test mesh. This VM's CPUID
+# advertises features the kernel doesn't enable (XLA's AOT loader warns
+# "Compile machine features ... vs host machine features ... could lead to
+# execution errors such as SIGILL"); cache-DESERIALIZED CPU collective
+# programs then deadlock with every thread futex-parked (root cause of the
+# round-4 suite wedges: cold runs pass deterministically, cache-hit runs
+# wedge). Opt back in explicitly with DSTPU_TEST_JIT_CACHE if your machine
+# loads its own cache entries cleanly.
+_cache_dir = os.environ.get("DSTPU_TEST_JIT_CACHE")
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import pytest  # noqa: E402
+
+
+# ---------------------------------------------------------------- sharding
+# A FULL-SUITE invocation (`pytest tests/ ...`) transparently runs as a few
+# sequential fresh-process shards. Reason: XLA's emulated-CPU collective
+# executor can deadlock (all threads futex-parked, 0% CPU, no watchdog fire)
+# after enough DISTINCT multi-device programs have run in one process on this
+# 1-core box. Empirically, file subsets of ~1/3 of the suite pass reliably
+# while single-process full runs wedge at probabilistic points (three round-4
+# runs: the NVMe step, the autotuner sweep, ...). Sharding keeps the
+# advertised `python -m pytest tests/ -x -q` entry point working; targeted
+# invocations (specific files/tests) are never sharded.
+_N_SHARDS = 4
+
+
+def pytest_cmdline_main(config):
+    if os.environ.get("DSTPU_SUITE_SHARD"):
+        return None  # we ARE a shard child: run normally
+    args = list(config.invocation_params.args)
+    positional = [a for a in args if not a.startswith("-")]
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    # shard only the full-suite spelling: `pytest tests/` (or the repo root)
+    roots = {tests_dir, os.path.dirname(tests_dir)}
+    if not positional or not all(
+            os.path.abspath(p.rstrip("/")) in roots for p in positional):
+        return None
+
+    import glob
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "dstpu_test_isolation", os.path.join(tests_dir, "unit", "isolation.py"))
+    isolation = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(isolation)
+
+    files = sorted(glob.glob(os.path.join(tests_dir, "unit", "test_*.py")))
+    if len(files) < _N_SHARDS + 1:
+        return None
+    flags = [a for a in args if a.startswith("-")]
+    # round-robin by position: spreads the heavy engine files across shards
+    shards = [files[i::_N_SHARDS] for i in range(_N_SHARDS)]
+    env = dict(os.environ)
+    env["DSTPU_SUITE_SHARD"] = "1"
+    rc = 0
+    for i, shard in enumerate(shards):
+        for attempt in range(3):
+            print(f"\n=== suite shard {i + 1}/{len(shards)} "
+                  f"({len(shard)} files"
+                  + (f", retry {attempt}" if attempt else "") + ") ===",
+                  flush=True)
+            shard_rc, stalled = isolation.run_with_stall_watchdog(
+                [sys.executable, "-m", "pytest", *flags, *shard],
+                env=env, stall_seconds=180, timeout=1500)
+            if shard_rc is not None:
+                rc = max(rc, shard_rc)
+                break
+            print(f"=== shard {i + 1} "
+                  + ("stalled (emulation deadlock, see tests/unit/"
+                     "isolation.py); retrying" if stalled else "timed out"),
+                  flush=True)
+        else:
+            rc = max(rc, 1)
+        if rc and ("-x" in flags or "--exitfirst" in flags):
+            break
+    return rc
 
 
 @pytest.fixture(autouse=True)

@@ -79,15 +79,6 @@ def test_hostfile_duplicate_raises(tmp_path):
         runner.fetch_hostfile(str(hf))
 
 
-def test_node_cmd_env():
-    cmd = runner.build_node_cmd("train.py", ["--foo", "1"], "h0:29500", 4, 2,
-                                {"XLA_FLAGS": "--xla_dump_to=/tmp/d"})
-    assert "export DSTPU_COORDINATOR=h0:29500;" in cmd
-    assert "export DSTPU_NUM_PROCESSES=4;" in cmd
-    assert "export DSTPU_PROCESS_ID=2;" in cmd
-    assert "train.py --foo 1" in cmd
-
-
 # ------------------------------------------------------- multinode runners
 def test_ssh_runner_cmds():
     from deepspeed_tpu.launcher.multinode_runner import SSHRunner

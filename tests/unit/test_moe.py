@@ -101,12 +101,7 @@ def test_mixtral_trains_dense_mesh():
     assert losses[-1] < losses[0], losses
 
 
-_ISOLATED_PREAMBLE = """
-import os
-os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-import jax
-jax.config.update("jax_platforms", "cpu")
-import numpy as np
+_MOE_SETUP = """
 import deepspeed_tpu
 from deepspeed_tpu.comm.topology import reset_topology
 from deepspeed_tpu.models import mixtral
@@ -127,24 +122,11 @@ def run(mesh, n=4, stage=0):
 
 
 def _run_isolated(body: str, marker: str) -> None:
-    """Run an EP training scenario in a clean subprocess (autotuner-trial /
-    dryrun self-spawn pattern): under a long-lived pytest process on this
-    1-core box, XLA's CPU collectives can wedge when an expert-mesh program
-    follows earlier mesh programs — a runtime scheduling artifact, not a
-    framework property (the identical sequence passes standalone)."""
-    import os
-    import subprocess
-    import sys
+    """EP training scenarios run in a clean subprocess — the in-process
+    multi-mesh collective wedge (see tests/unit/isolation.py)."""
+    from isolation import run_isolated
 
-    env = dict(os.environ)
-    env.pop("PYTEST_CURRENT_TEST", None)
-    repo = os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
-    proc = subprocess.run(
-        [sys.executable, "-c", _ISOLATED_PREAMBLE + body], env=env,
-        capture_output=True, text=True, timeout=600, cwd=repo)
-    assert proc.returncode == 0, proc.stderr[-3000:]
-    assert marker in proc.stdout
+    run_isolated(_MOE_SETUP + body, marker)
 
 
 def test_expert_parallel_loss_parity():

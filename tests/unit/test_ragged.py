@@ -147,6 +147,49 @@ class TestRaggedEngine:
             if eos in toks:
                 assert toks.index(eos) == len(toks) - 1  # truncated at EOS
 
+    def test_tiled_prefill_token_parity(self):
+        """The tile-aligned prefill layout + tiled attention path must emit
+        exactly the per-token engine's greedy tokens (XLA fallback on CPU
+        exercises the scheduler layout + metadata; kernel math is covered by
+        test_paged_attention's interpret-mode parity)."""
+        import dataclasses
+
+        prompts = _prompts(13)
+        max_new = 7
+        base = RaggedInferenceEngine(
+            lambda ctx: llama.build(CFG, ctx=ctx), RCFG,
+            dtype=jnp.float32, seed=0,
+        )
+        for uid, p in prompts.items():
+            base.put(uid, p, max_new_tokens=max_new)
+        expect = base.generate_all()
+
+        tiled = RaggedInferenceEngine(
+            lambda ctx: llama.build(CFG, ctx=ctx),
+            dataclasses.replace(RCFG, prefill_tile=8),
+            dtype=jnp.float32, seed=0,
+        )
+        for uid, p in prompts.items():
+            tiled.put(uid, p, max_new_tokens=max_new)
+        got = tiled.generate_all()
+        assert got == expect
+        assert tiled._tiled_jits, "tiled step programs never engaged"
+
+    def test_tiled_prefill_rejected_without_model_support(self):
+        import dataclasses
+
+        def build_no_tiles(ctx):
+            spec = llama.build(CFG, ctx=ctx)
+            spec.supports_prefill_tiles = False
+            return spec
+
+        with pytest.raises(ValueError, match="prefill_tiles"):
+            RaggedInferenceEngine(
+                build_no_tiles,
+                dataclasses.replace(RCFG, prefill_tile=8),
+                dtype=jnp.float32, seed=0,
+            )
+
     def test_continuous_admission(self):
         """A request put() mid-flight (while others decode) still matches the
         dense reference — continuous batching semantics."""

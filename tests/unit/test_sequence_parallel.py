@@ -103,3 +103,88 @@ def test_sp_training_loss_parity(mode):
     base = run({"data": 8}, "ulysses")
     sp = run({"data": 2, "sequence": 4}, mode)
     np.testing.assert_allclose(base, sp, rtol=3e-4, atol=3e-5)
+
+
+# ------------------------------------------------------------------ AutoSP
+def _user_model_spec(vocab=VOCAB, d=32, heads=4, layers=2):
+    """A model written WITHOUT ShardCtx, using the standard
+    jax.nn.dot_product_attention — the AutoSP target
+    (reference sequence/auto_sp.py: detect sdpa, insert SP collectives)."""
+    from functools import partial
+
+    from deepspeed_tpu.models.api import ModelSpec, causal_lm_loss
+
+    hd = d // heads
+
+    def init_fn(rng):
+        ks = jax.random.split(rng, 4)
+        return {
+            "embed": jax.random.normal(ks[0], (vocab, d)) * 0.02,
+            "layers": {
+                "wqkv": jax.random.normal(ks[1], (layers, d, 3 * d)) * 0.02,
+                "wo": jax.random.normal(ks[2], (layers, d, d)) * 0.02,
+                "w_mlp": jax.random.normal(ks[3], (layers, d, d)) * 0.02,
+            },
+        }
+
+    def forward(params, ids):
+        x = params["embed"][ids]
+        b, s, _ = x.shape
+
+        def layer(x, lp):
+            qkv = (x @ lp["wqkv"]).reshape(b, s, 3, heads, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            o = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+            x = x + o.reshape(b, s, -1) @ lp["wo"]
+            return x + jax.nn.gelu(x @ lp["w_mlp"]), None
+
+        x, _ = jax.lax.scan(layer, x, params["layers"])
+        return x @ params["embed"].T
+
+    def loss_fn(params, batch, rng=None):
+        return causal_lm_loss(forward(params, batch["input_ids"]),
+                              batch["input_ids"])
+
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": {"wqkv": ("layers", "embed", None),
+                   "wo": ("layers", "embed", "embed"),
+                   "w_mlp": ("layers", "embed", "embed")},
+    }
+    return ModelSpec(name="user-sdpa", config=None, init_fn=init_fn,
+                     loss_fn=loss_fn, forward_fn=forward,
+                     param_logical_axes=axes)
+
+
+def test_auto_sp_user_model_parity():
+    """A ShardCtx-free user model trains under sequence_parallel.auto with
+    the same trajectory as pure DP — the patched sdpa routed its attention
+    through Ulysses."""
+    batches = [
+        {"input_ids": np.random.default_rng(i).integers(0, VOCAB, (8, 32), dtype=np.int32)}
+        for i in range(3)
+    ]
+
+    def run(mesh, auto):
+        reset_topology()
+        cfg = _sp_config("ulysses", mesh)
+        cfg["sequence_parallel"]["auto"] = auto
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=lambda ctx: _user_model_spec(), config=cfg, seed=11)
+        return [float(engine.train_batch(b)) for b in batches]
+
+    base = run({"data": 8}, auto=False)
+    sp = run({"data": 2, "sequence": 4}, auto=True)
+    assert all(np.isfinite(sp))
+    np.testing.assert_allclose(base, sp, rtol=3e-4, atol=3e-5)
+
+
+def test_auto_sp_patch_is_scoped():
+    """The sdpa patch must not leak outside the auto_sp context."""
+    from deepspeed_tpu.parallel.auto_sp import auto_sp
+
+    topo = init_distributed(MeshConfig(data=2, sequence=4))
+    orig = jax.nn.dot_product_attention
+    with auto_sp(topo.mesh):
+        assert jax.nn.dot_product_attention is not orig
+    assert jax.nn.dot_product_attention is orig
