@@ -204,12 +204,16 @@ class Autotuner:
         limit = memory_bytes if memory_bytes is not None else device_memory_bytes()
         n_dev = len(jax.devices())
 
+        base_remat = bool(self.base_config.get(
+            "activation_checkpointing", {}).get("enabled"))
         for stage in zero_stages:
             for mb in micro_batch_sizes:
                 overrides = {"zero_stage": stage, "micro_batch": mb}
                 if limit and info.num_params:
-                    est = (info.state_bytes(stage, n_dev)
-                           + info.activation_bytes(mb, seq_len))
+                    act = info.activation_bytes(mb, seq_len)
+                    if try_remat or base_remat:
+                        act /= 2  # prune against the BEST variant to be tried
+                    est = info.state_bytes(stage, n_dev) + act
                     if est > 0.9 * limit:
                         self._record(TrialResult(
                             overrides=overrides,

@@ -402,6 +402,20 @@ class RaggedInferenceEngine:
             self._reserved += worst
             self._running[seq.slot] = seq
 
+    def _emit_tokens(self, logits, emit) -> dict:
+        """Shared step epilogue: greedy-pick at the emit indices, extend the
+        sequences, release finished ones."""
+        out: dict = {}
+        if emit:
+            idx = np.asarray([i for i, _ in emit])
+            picked = np.asarray(jnp.argmax(logits[idx].astype(jnp.float32), axis=-1))
+            for (_, seq), tok in zip(emit, picked):
+                seq.generated.append(int(tok))
+                out[seq.uid] = int(tok)
+                if seq.finished:
+                    self._release(seq)
+        return out
+
     def _deadlock_guard(self, n: int) -> None:
         if n == 0:
             # has_work but nothing schedulable: every sequence is stalled on
@@ -463,16 +477,7 @@ class RaggedInferenceEngine:
             jnp.asarray(positions[:bucket]),
             jnp.asarray(self.block_tables),
         )
-        out: dict = {}
-        if emit:
-            idx = np.asarray([i for i, _ in emit])
-            picked = np.asarray(jnp.argmax(logits[idx].astype(jnp.float32), axis=-1))
-            for (_, seq), tok in zip(emit, picked):
-                seq.generated.append(int(tok))
-                out[seq.uid] = int(tok)
-                if seq.finished:
-                    self._release(seq)
-        return out
+        return self._emit_tokens(logits, emit)
 
     def _get_tiled_step(self, nd: int, nt: int):
         """Jitted step with a static (decode-count, tile-count) split; one
@@ -566,16 +571,7 @@ class RaggedInferenceEngine:
             jnp.asarray(tv[:max(nt, 1)]),
             jnp.asarray(self.block_tables),
         )
-        out: dict = {}
-        if emit:
-            idx = np.asarray([i for i, _ in emit])
-            picked = np.asarray(jnp.argmax(logits[idx].astype(jnp.float32), axis=-1))
-            for (_, seq), tok in zip(emit, picked):
-                seq.generated.append(int(tok))
-                out[seq.uid] = int(tok)
-                if seq.finished:
-                    self._release(seq)
-        return out
+        return self._emit_tokens(logits, emit)
 
     # ------------------------------------------------------------------ convenience
     def generate_all(self, max_steps: int = 10_000) -> dict:

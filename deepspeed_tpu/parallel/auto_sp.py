@@ -63,10 +63,24 @@ class auto_sp:
                 return orig(query, key, value, bias, mask, *args,
                             is_causal=is_causal, **kwargs)
             if mode == "ring":
+                unsupported = [k for k, v in kwargs.items()
+                               if k != "scale" and v is not None]
+                if args or unsupported:
+                    # length masks / local windows / implementation pins:
+                    # the ring kernel has no equivalents — fall back loudly
+                    if not _WARNED:
+                        _WARNED = True
+                        logger.warning(
+                            "auto_sp(ring): unsupported dot_product_attention "
+                            "options %s — gathered attention instead",
+                            unsupported or "positional")
+                    return orig(query, key, value, bias, mask, *args,
+                                is_causal=is_causal, **kwargs)
                 from deepspeed_tpu.parallel.ring_attention import ring_attention
 
                 return ring_attention(query, key, value, mesh,
-                                      causal=is_causal)
+                                      causal=is_causal,
+                                      scale=kwargs.get("scale"))
             from deepspeed_tpu.parallel.ulysses import ulysses_attention
 
             local = lambda q, k, v: orig(  # noqa: E731

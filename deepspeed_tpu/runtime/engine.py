@@ -111,12 +111,19 @@ class Engine:
         # ShardCtx get sequence parallelism by patching the standard
         # attention entry point during tracing (parallel/auto_sp.py)
         if sp_cfg.auto and topo.size("sequence") > 1:
+            import dataclasses as _dc
+
             from deepspeed_tpu.parallel.auto_sp import wrap_loss_fn
 
-            self.model_spec.loss_fn = wrap_loss_fn(
-                self.model_spec.loss_fn, topo.mesh, sp_cfg.mode)
-            self.model_spec.forward_fn = wrap_loss_fn(
-                self.model_spec.forward_fn, topo.mesh, sp_cfg.mode)
+            # a COPY of the spec: mutating the caller's object would
+            # double-wrap on re-initialize (elastic restart / A-B runs) and
+            # leak the patch into unrelated engines sharing the spec
+            self.model_spec = _dc.replace(
+                self.model_spec,
+                loss_fn=wrap_loss_fn(self.model_spec.loss_fn, topo.mesh,
+                                     sp_cfg.mode),
+                forward_fn=wrap_loss_fn(self.model_spec.forward_fn, topo.mesh,
+                                        sp_cfg.mode))
             log_dist("auto_sp: jax.nn.dot_product_attention routed through "
                      f"{sp_cfg.mode} sequence parallelism", ranks=[0])
 

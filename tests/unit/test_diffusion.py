@@ -1,0 +1,82 @@
+"""Diffusion UNet family (the reference's diffusers/spatial surface,
+``model_implementations/diffusers/`` + ``csrc/spatial``): the model-agnostic
+engine trains it unchanged; the DDIM sampler is one compiled scan."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.topology import reset_topology
+from deepspeed_tpu.models import diffusion
+
+
+def _cfg():
+    return diffusion.UNetConfig.tiny()
+
+
+def test_forward_shapes_and_determinism():
+    cfg = _cfg()
+    params = diffusion.init_params(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (2, cfg.image_size, cfg.image_size, cfg.in_channels))
+    t = jnp.array([0, 50])
+    out = jax.jit(lambda p, x, t: diffusion.forward(cfg, p, x, t))(params, x, t)
+    assert out.shape == x.shape
+    out2 = jax.jit(lambda p, x, t: diffusion.forward(cfg, p, x, t))(params, x, t)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # timestep conditioning is live: different t -> different prediction
+    # (small at init by design — the resblocks' output convs start near zero,
+    # the standard DDPM init — but strictly nonzero)
+    out3 = jax.jit(lambda p, x, t: diffusion.forward(cfg, p, x, t))(
+        params, x, jnp.array([99, 99]))
+    assert np.abs(np.asarray(out) - np.asarray(out3)).max() > 1e-9
+
+
+def test_engine_trains_unet_under_zero2():
+    """The SAME engine that trains LMs trains the UNet (loss contract is
+    model-agnostic): noise-prediction MSE descends under ZeRO-2 x fsdp."""
+    reset_topology()
+    cfg = _cfg()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: diffusion.build(cfg, ctx=ctx),
+        config={
+            "train_micro_batch_size_per_device": 2,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 0,
+            "gradient_clipping": 1.0,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": 2, "fsdp": 4},
+            "seed": 3,
+        })
+    # a fixed structured image set: the epsilon objective is learnable
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(16, cfg.image_size, cfg.image_size,
+                            cfg.in_channels)).astype(np.float32)
+    losses = [float(engine.train_batch({"images": base})) for _ in range(8)]
+    assert all(np.isfinite(losses)), losses
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+    # conv kernels sharded over fsdp per the planner (output-channel dim)
+    big = max(jax.tree_util.tree_leaves(engine.params), key=lambda x: x.size)
+    assert "fsdp" in str(big.sharding.spec)
+
+
+def test_ddim_sampler_shapes_and_determinism():
+    cfg = _cfg()
+    params = diffusion.init_params(cfg, jax.random.PRNGKey(0))
+    sample = jax.jit(lambda p, r: diffusion.ddim_sample(cfg, p, r, batch=2,
+                                                        num_steps=5))
+    a = sample(params, jax.random.PRNGKey(7))
+    b = sample(params, jax.random.PRNGKey(7))
+    assert a.shape == (2, cfg.image_size, cfg.image_size, cfg.in_channels)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = sample(params, jax.random.PRNGKey(8))
+    assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-6
+    assert np.all(np.isfinite(np.asarray(a)))
+
+
+def test_noise_schedule_monotone():
+    ab = np.asarray(diffusion.ddpm_schedule(100))
+    assert ab.shape == (100,)
+    assert np.all(np.diff(ab) < 0) and ab[0] < 1.0 and ab[-1] > 0.0
