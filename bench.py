@@ -310,6 +310,62 @@ def serve_trial_main():
     naive_s = time.perf_counter() - t0
 
     sched = ragged.tokens_scheduled + ragged.tokens_padded
+
+    # ------------------------------------------------- staggered arrivals
+    # The FastGen effective-throughput scenario: requests ARRIVE over time.
+    # Dense serving must run wave-by-wave (whoever has arrived pads into a
+    # full batch and later arrivals wait out the whole generation);
+    # continuous batching admits mid-flight. Latency = finish - arrival.
+    interval = (0.15 if on_tpu else 0.5)  # seconds between arrivals
+    arrivals = [i * interval for i in range(n_req)]
+
+    def run_ragged_staggered(tag):
+        lat = {}
+        t0 = time.perf_counter()
+        nxt = 0
+        while nxt < n_req or ragged.has_work:
+            now = time.perf_counter() - t0
+            while nxt < n_req and arrivals[nxt] <= now:
+                ragged.put((tag, nxt), prompts[nxt], max_new_tokens=max_new)
+                nxt += 1
+            if ragged.has_work:
+                done_before = ragged.finished_uids
+                ragged.step()
+                for uid in ragged.finished_uids - done_before:
+                    lat[uid] = (time.perf_counter() - t0) - arrivals[uid[1]]
+            elif nxt < n_req:
+                time.sleep(max(0.0, arrivals[nxt] - (time.perf_counter() - t0)))
+        return lat
+
+    def run_dense_staggered():
+        lat = {}
+        t0 = time.perf_counter()
+        nxt = 0
+        while nxt < n_req:
+            now = time.perf_counter() - t0
+            if arrivals[nxt] > now:
+                time.sleep(arrivals[nxt] - now)
+            now = time.perf_counter() - t0
+            wave = []
+            while nxt < n_req and arrivals[nxt] <= now and len(wave) < max_seqs:
+                wave.append(nxt)
+                nxt += 1
+            # always the warmed full-batch program: a per-wave-size program
+            # would compile inside the timed region, and the full-batch
+            # padding IS dense serving's cost under continuous load
+            batch = pad_batch([prompts[i] for i in wave]
+                              + [prompts[0]] * (max_seqs - len(wave)))
+            dense.generate(batch, max_new_tokens=max_new)
+            fin = time.perf_counter() - t0
+            for i in wave:
+                lat[i] = fin - arrivals[i]
+        return lat
+
+    run_ragged_staggered("w")  # warm: compiles the staggered-mix programs
+    rag_lat = list(run_ragged_staggered("s").values())
+    den_lat = list(run_dense_staggered().values())
+    rag_mean = sum(rag_lat) / len(rag_lat)
+    den_mean = sum(den_lat) / len(den_lat)
     print(json.dumps({
         "ragged_tokens_per_s": round(useful_tokens / ragged_s, 1),
         "dense_tokens_per_s": round(useful_tokens / dense_s, 1),
@@ -317,6 +373,17 @@ def serve_trial_main():
         "ragged_vs_dense": round(dense_s / ragged_s, 3),
         "ragged_vs_naive": round(naive_s / ragged_s, 3),
         "ragged_padding_frac": round(ragged.tokens_padded / max(sched, 1), 4),
+        # staggered-arrival (continuous) load: mean per-request latency and
+        # the dense/ragged ratio — >1 means continuous batching wins. On
+        # THIS transport the ratio is dominated by the flat per-dispatch RTT
+        # (~180 ms): mixed prefill/decode steps emit ~1 token/seq/dispatch
+        # while the dense baseline amortizes a whole wave into one scan.
+        # On a local TPU host (sub-ms dispatch) the same scheduling is
+        # compute-bound and the comparison flips — read these numbers as a
+        # transport measurement, not engine quality (see bench docstring).
+        "staggered_ragged_mean_latency_s": round(rag_mean, 3),
+        "staggered_dense_mean_latency_s": round(den_mean, 3),
+        "staggered_latency_ratio": round(den_mean / rag_mean, 3),
         "serve_reqs": n_req,
         "serve_useful_tokens": useful_tokens,
         "serve_max_new": max_new,

@@ -91,6 +91,12 @@ class RaggedConfig:
     # token (ops/pallas ragged_prefill_attention — the SplitFuse blocked
     # flash attention). 0 disables (per-token kernel for everything).
     prefill_tile: int = 0
+    # with arrivals queued but UNADMITTABLE (a free slot exists yet the KV
+    # pool can't cover the reservation), run-ahead still fuses up to this
+    # many decode steps per dispatch — decode progress is exactly what frees
+    # blocks; admittable requests are admitted before run-ahead is even
+    # considered. Only active when decode_run_ahead is set.
+    run_ahead_admission_cap: int = 8
 
     @property
     def max_seq_len(self) -> int:
@@ -251,6 +257,12 @@ class RaggedInferenceEngine:
     def has_work(self) -> bool:
         return bool(self._queued or self._running)
 
+    @property
+    def finished_uids(self):
+        """UIDs of completed requests (public completion signal; the full
+        token lists come from ``generate_all`` / the per-uid state)."""
+        return set(self._results)
+
     # ------------------------------------------------------------------ step
     def _worst_case_blocks(self, seq: _SeqState) -> int:
         total = len(seq.prompt) + seq.max_new_tokens
@@ -323,10 +335,15 @@ class RaggedInferenceEngine:
         single SplitFuse step."""
         k_max = self.cfg.decode_run_ahead
         seqs = list(self._running.values())
-        if (k_max < 2 or not seqs
-                or any(not s.in_decode for s in seqs)
-                or (self._queued and self._free_slots)):
+        if k_max < 2 or not seqs or any(not s.in_decode for s in seqs):
             return None
+        if self._queued and self._free_slots:
+            # a queued request has a slot but the pool can't cover its
+            # reservation (step() already admitted everything admittable):
+            # fuse a BOUNDED chunk — decode progress is what frees blocks
+            k_max = min(k_max, self.cfg.run_ahead_admission_cap)
+            if k_max < 2:
+                return None
         k = min(k_max, min(s.max_new_tokens - len(s.generated) for s in seqs))
         while k >= 2 and not all(self._ensure_capacity(s, s.pos + k)
                                  for s in seqs):
@@ -435,6 +452,13 @@ class RaggedInferenceEngine:
         sequence's chunk; the full stream is in the per-sequence state)."""
         if not self.has_work:
             return {}
+        # admission FIRST: a newly admitted sequence is in prefill, which
+        # disables run-ahead for this step — so queued requests are admitted
+        # within one step whenever a slot + pool reservation exist, and the
+        # admission-capped run-ahead below only governs the pool-blocked case
+        # (without this order, capped chunks re-fire back-to-back and starve
+        # admission for up to a whole generation)
+        self._admit_queued()
         ahead = self._try_decode_run_ahead()
         if ahead is not None:
             return ahead
@@ -446,7 +470,6 @@ class RaggedInferenceEngine:
         positions = np.zeros(budget, np.int32)
         emit: list[tuple[int, _SeqState]] = []
         n = self._schedule_decodes(budget, tokens, slots, positions, emit)
-        self._admit_queued()
 
         # 3) prefill chunks for running prompts within the remaining budget
         for seq in list(self._running.values()):
