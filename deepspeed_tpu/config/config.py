@@ -238,11 +238,14 @@ class ZeroConfig(ConfigBase):
                     "secondary-partition group is the mesh's fsdp axis)."
                 )
                 data["hierarchical_partitioning"] = True
-        # Reference spelling for qwZ (`zero_quantized_weights`).
-        if "zero_quantized_weights" in data and "quantized_weights" not in data:
-            data["quantized_weights"] = data.pop("zero_quantized_weights")
-        else:
-            data.pop("zero_quantized_weights", None)
+        # Reference spellings for qwZ/qgZ (`zero_quantized_weights`,
+        # `zero_quantized_gradients`).
+        for ref_key, key in (("zero_quantized_weights", "quantized_weights"),
+                             ("zero_quantized_gradients", "quantized_gradients")):
+            if ref_key in data and key not in data:
+                data[key] = data.pop(ref_key)
+            else:
+                data.pop(ref_key, None)
         # Legacy `cpu_offload` was a bool; translate to an offload tier, not a rename.
         if "cpu_offload" in data:
             from deepspeed_tpu.utils.logging import logger

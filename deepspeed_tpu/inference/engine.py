@@ -144,50 +144,87 @@ class InferenceEngine:
         self.params = loaded
 
     # ------------------------------------------------------------------ generate
-    def _build_generate(self, batch: int, prompt_len: int, max_new: int, sample: bool):
+    def _build_generate(self, batch: int, prompt_len: int, max_new: int,
+                        sample: bool, use_penalty: bool, has_tk: bool,
+                        has_tp: bool):
         decode = self.spec.decode_fn
         init_cache = self.spec.init_cache_fn
         total = prompt_len + max_new
 
-        def generate_fn(params, tokens, rng, temperature):
+        def generate_fn(params, tokens, rng, temperature, top_k, top_p,
+                        rep_pen):
+            from deepspeed_tpu.inference.sampling import (
+                sample_tokens,
+                update_seen,
+            )
+
             cache = init_cache(batch, total, self.dtype)
             logits, cache = decode(params, tokens, cache, 0)
             last = logits[:, prompt_len - 1].astype(jnp.float32)
+            vocab = last.shape[-1]
+            # occurrence mask over the prompt (HF repetition_penalty
+            # semantics: penalize everything in the context)
+            seen0 = (jnp.zeros((batch, vocab), jnp.bool_)
+                     .at[jnp.arange(batch)[:, None], tokens].set(True)
+                     if use_penalty else jnp.zeros((batch, 1), jnp.bool_))
 
-            def pick(logits_f, r):
-                if not sample:
+            def pick(logits_f, r, seen):
+                if not sample and not use_penalty:
                     return jnp.argmax(logits_f, axis=-1).astype(jnp.int32)
-                return jax.random.categorical(r, logits_f / temperature).astype(jnp.int32)
+                toks, _ = sample_tokens(
+                    logits_f, r,
+                    temperature if sample else jnp.float32(0.0),
+                    # None compiles the top-k/top-p sorts OUT when disabled
+                    # (the flags are static in the cache key)
+                    top_k=top_k if has_tk else None,
+                    top_p=top_p if has_tp else None,
+                    repetition_penalty=rep_pen if use_penalty else None,
+                    seen_mask=seen if use_penalty else None)
+                return toks
 
             def step(carry, i):
-                last, cache = carry
+                last, cache, seen = carry
                 r = jax.random.fold_in(rng, i)
-                tok = pick(last, r)
+                tok = pick(last, r, seen)
+                if use_penalty:
+                    seen = update_seen(seen, tok)
                 logits, cache = decode(params, tok[:, None], cache, prompt_len + i)
-                return (logits[:, 0].astype(jnp.float32), cache), tok
+                return (logits[:, 0].astype(jnp.float32), cache, seen), tok
 
-            (_, _), toks = jax.lax.scan(step, (last, cache), jnp.arange(max_new))
+            (_, _, _), toks = jax.lax.scan(
+                step, (last, cache, seen0), jnp.arange(max_new))
             return toks.T  # [B, max_new]
 
         return jax.jit(generate_fn)
 
     def generate(self, input_ids, max_new_tokens: int = 64, temperature: float = 0.0,
-                 seed: int = 0):
-        """[B, T] prompt -> [B, T + max_new_tokens] (greedy when temperature=0).
+                 seed: int = 0, top_k: int = 0, top_p: float = 1.0,
+                 repetition_penalty: float = 1.0):
+        """[B, T] prompt -> [B, T + max_new_tokens] (greedy when temperature=0;
+        ``top_k``/``top_p``/``repetition_penalty`` follow the reference
+        generate surface, ``inference/engine.py:586 _generate`` forwarding HF
+        sampling kwargs — see ``inference/sampling.py``).
 
-        Reference ``inference/engine.py:586 _generate``; each (B, T, N) shape
-        signature compiles once and replays (CUDA-graph parity)."""
+        Each (B, T, N, sampled?, penalized?) signature compiles once and
+        replays (CUDA-graph parity); the sampling VALUES are traced, so
+        changing temperature/top_k/top_p never recompiles."""
         input_ids = np.asarray(input_ids)
         b, t = input_ids.shape
         sample = temperature > 0.0
-        key = (b, t, max_new_tokens, sample)
+        use_penalty = repetition_penalty != 1.0
+        has_tk, has_tp = top_k > 0, top_p < 1.0
+        key = (b, t, max_new_tokens, sample, use_penalty, has_tk, has_tp)
         if key not in self._gen_cache:
-            self._gen_cache[key] = self._build_generate(b, t, max_new_tokens, sample)
+            self._gen_cache[key] = self._build_generate(
+                b, t, max_new_tokens, sample, use_penalty, has_tk, has_tp)
         toks = self._gen_cache[key](
             self.params,
             jnp.asarray(input_ids),
             jax.random.PRNGKey(seed),
             jnp.float32(max(temperature, 1e-6)),
+            jnp.int32(top_k),
+            jnp.float32(top_p),
+            jnp.float32(repetition_penalty),
         )
         return np.concatenate([input_ids, np.asarray(toks)], axis=1)
 

@@ -97,6 +97,18 @@ class RaggedConfig:
     # blocks; admittable requests are admitted before run-ahead is even
     # considered. Only active when decode_run_ahead is set.
     run_ahead_admission_cap: int = 8
+    # fused mixed chunks (>= 2 enables): EVERY dispatch is one program that
+    # runs the mixed SplitFuse step (decodes + prefill chunks) and then
+    # fused_chunk-1 further decode steps for the decode rows, next tokens
+    # fed back on device. Unlike decode_run_ahead (which only engages when
+    # every running sequence decodes), arrivals never break the fusion —
+    # the high-RTT-transport fix the round-4 bench demanded.
+    fused_chunk: int = 0
+    # how many fused chunks may be in flight undispatched-results-wise:
+    # chunk t+1 is dispatched before chunk t's tokens are read back, the
+    # next-token feed riding a device-resident per-slot buffer (bounded
+    # speculation; EOS reconciled on readback)
+    pipeline_depth: int = 2
 
     @property
     def max_seq_len(self) -> int:
@@ -117,6 +129,13 @@ class _SeqState:
     blocks: list[int] = field(default_factory=list)
     reserved_remaining: int = 0  # worst-case blocks reserved but not yet held
     done: bool = False
+    # sampling controls (reference generate kwargs; 0-temperature = greedy)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    # fused-pipeline bookkeeping: chunks dispatched but not yet reconciled
+    # that reference this sequence (release deferred until it drains)
+    refs: int = 0
 
     def token_at(self, p: int) -> int:
         if p < len(self.prompt):
@@ -215,10 +234,28 @@ class RaggedInferenceEngine:
             self._dec_buckets.append(b)
             b *= 2
         self._dec_buckets.append(self.cfg.max_seqs)
+        # fused mixed-chunk pipeline (see RaggedConfig.fused_chunk)
+        self._fused_jits: dict = {}
+        self._inflight_chunks: list = []
+        # per-slot device buffer of the latest emitted token (+1 scratch row):
+        # the next chunk's decode feed reads it ON DEVICE, so chunk t+1 can
+        # dispatch before chunk t's tokens ever reach the host
+        self._slot_toks = jnp.zeros(self.cfg.max_seqs + 1, jnp.int32)
+        # host mirror of which slots have a valid device-side next token
+        self._slot_feed = np.zeros(self.cfg.max_seqs + 1, bool)
+        self._dispatch_rng = jax.random.PRNGKey(seed ^ 0x5EED)
+        self._chunk_counter = 0
+        if self.cfg.fused_chunk == 1 or self.cfg.fused_chunk < 0:
+            raise ValueError("fused_chunk must be 0 (off) or >= 2")
+        if self.cfg.fused_chunk and self.cfg.pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         # scheduling efficiency telemetry (padding fraction; comparable to the
-        # dense engine's pad-to-max waste)
+        # dense engine's pad-to-max waste) + dispatch accounting (on a
+        # high-RTT transport, dispatches per token is the serving cost)
         self.tokens_scheduled = 0
         self.tokens_padded = 0
+        self.dispatch_count = 0
+        self.tokens_emitted = 0
         log_dist(
             f"RaggedInferenceEngine: model={self.spec.name} "
             f"budget={self.cfg.max_tokens_per_step} max_seqs={self.cfg.max_seqs} "
@@ -227,9 +264,14 @@ class RaggedInferenceEngine:
 
     # ------------------------------------------------------------------ put
     def put(self, uid, prompt_tokens, max_new_tokens: int = 64,
-            eos_token_id: int | None = None) -> None:
+            eos_token_id: int | None = None, temperature: float = 0.0,
+            top_k: int = 0, top_p: float = 1.0) -> None:
         """Enqueue a request (reference ``engine_v2.py put()``). Admission into
-        the running batch happens inside ``step()`` as slots/budget free up."""
+        the running batch happens inside ``step()`` as slots/budget free up.
+        ``temperature``/``top_k``/``top_p`` select per-request sampling
+        (0-temperature = greedy), applied inside the compiled step — sampled
+        decode works under run-ahead and the fused pipeline with no host
+        round trip (``inference/sampling.py``)."""
         prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -251,11 +293,13 @@ class RaggedInferenceEngine:
         self._queued.append(_SeqState(
             uid=uid, prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id if eos_token_id is not None else self.eos_token_id,
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p),
         ))
 
     @property
     def has_work(self) -> bool:
-        return bool(self._queued or self._running)
+        return bool(self._queued or self._running or self._inflight_chunks)
 
     @property
     def finished_uids(self):
@@ -308,22 +352,34 @@ class RaggedInferenceEngine:
         return jax.jit(step_fn, donate_argnums=(1,))
 
     def _build_decode_chunk(self) -> Callable:
-        """K fused greedy decode steps over the paged cache: one dispatch,
-        next-token argmax fed back on device, KV scattered per step. ``K`` is
-        static (jit specializes per (K, batch) pair)."""
+        """K fused decode steps over the paged cache: one dispatch, next
+        token (greedy or per-request sampled) fed back on device, KV
+        scattered per step. ``K`` and the sampled? flag are static (jit
+        specializes per (K, batch, sampled) triple)."""
         fwd = self.spec.ragged_forward_fn
         from functools import partial
 
-        @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-        def chunk_fn(k, params, cache, tokens, slots, positions, block_tables):
-            def one(carry, _):
+        @partial(jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=(5,))
+        def chunk_fn(k, sampled, has_tk, has_tp, params, cache, tokens, slots,
+                     positions, block_tables, rng, temp, topk, topp):
+            def pick(lg, r):
+                if not sampled:
+                    return jnp.argmax(
+                        lg.astype(jnp.float32), axis=-1).astype(jnp.int32)
+                from deepspeed_tpu.inference.sampling import sample_tokens
+
+                return sample_tokens(lg, r, temp,
+                                     top_k=topk if has_tk else None,
+                                     top_p=topp if has_tp else None)[0]
+
+            def one(carry, i):
                 cache, toks, pos = carry
                 logits, cache = fwd(params, toks, slots, pos, block_tables, cache)
-                nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+                nxt = pick(logits, jax.random.fold_in(rng, i))
                 return (cache, nxt, pos + 1), nxt
 
             (cache, _, _), out = jax.lax.scan(
-                one, (cache, tokens, positions), None, length=k)
+                one, (cache, tokens, positions), jnp.arange(k))
             return out, cache  # out: [K, T] generated tokens
 
         return chunk_fn
@@ -359,17 +415,28 @@ class RaggedInferenceEngine:
         tokens = np.zeros(bucket, np.int32)
         slots = np.full(bucket, self.cfg.max_seqs, np.int32)
         positions = np.zeros(bucket, np.int32)
+        temp = np.zeros(bucket, np.float32)
+        topk = np.zeros(bucket, np.int32)
+        topp = np.ones(bucket, np.float32)
+        sampled = False
         for j, s in enumerate(seqs):
             tokens[j] = s.token_at(s.pos)
             slots[j] = s.slot
             positions[j] = s.pos
+            temp[j], topk[j], topp[j] = s.temperature, s.top_k, s.top_p
+            sampled = sampled or s.temperature > 0.0
         if self._chunk_jit is None:
             self._chunk_jit = self._build_decode_chunk()
+        rng = jax.random.fold_in(self._dispatch_rng, self._chunk_counter)
+        self._chunk_counter += 1
         out, self.cache = self._chunk_jit(
-            k, self.params, self.cache,
+            k, sampled, bool(topk.any()), bool((topp < 1.0).any()),
+            self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(positions),
-            jnp.asarray(self.block_tables),
+            jnp.asarray(self.block_tables), rng,
+            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
         )
+        self.dispatch_count += 1
         out = np.asarray(out)  # [K, bucket]
         self.tokens_scheduled += k * t
         self.tokens_padded += k * (bucket - t)
@@ -385,6 +452,324 @@ class RaggedInferenceEngine:
             if s.finished:
                 self._release(s)
         return emit
+
+    def _plan_prefill_tiles(self, nd: int, budget: int):
+        """Pick tile-aligned prompt chunks for this step (shared by the
+        legacy tiled step and the fused pipeline — the tile-capacity walk,
+        the capacity backoff under pool pressure, and the power-of-2 tile
+        rounding with its non-power-of-2 cap fixup live HERE only).
+
+        Returns ``(chunks, nt)``: ``chunks`` is ``[(seq, tile0, take)]``
+        with ``tile0`` the chunk's first tile index relative to the tile
+        region; ``nt`` the padded tile count. Does NOT advance ``seq.pos`` —
+        callers fill their token arrays from the current pos, then advance.
+        """
+        ct = self.cfg.prefill_tile
+        ntiles_cap = max(0, (budget - nd) // ct)
+        tiles_used = 0
+        chunks: list[tuple[_SeqState, int, int]] = []
+        for seq in list(self._running.values()):
+            if seq.finished or seq.in_decode or tiles_used >= ntiles_cap:
+                continue
+            avail = (ntiles_cap - tiles_used) * ct
+            take = min(avail, len(seq.prompt) - seq.pos)
+            while take and not self._ensure_capacity(seq, seq.pos + take):
+                take -= 1  # partial chunk under pool pressure
+            if take <= 0:
+                continue
+            chunks.append((seq, tiles_used, take))
+            tiles_used += -(-take // ct)
+        if tiles_used == 0:
+            return chunks, 0
+        nt = 1
+        while nt < tiles_used:
+            nt *= 2
+        nt = min(nt, max(1, ntiles_cap))
+        if nt < tiles_used:  # cap can be non-power-of-2
+            nt = tiles_used
+        return chunks, nt
+
+    # ------------------------------------------------- fused mixed pipeline
+    def _get_fused_chunk(self, k: int, nd: int, nt: int, sampled: bool,
+                         has_tk: bool = False, has_tp: bool = False):
+        """One program = one mixed SplitFuse step + (k-1) decode steps for
+        the decode region, next tokens fed back on device (the FastGen
+        multi-step idiom, reference ``engine_v2.py:30`` + the SplitFuse
+        policy of ``blogs/deepspeed-fastgen/README.md:28`` — generalized so
+        arrivals never break the fusion: the prompt chunk rides step 0 of
+        the same dispatched program the decodes run ahead in).
+
+        Rows [0, nd) are the decode region (padding rows -> scratch);
+        rows [nd, T) the prefill region (tile-aligned when ``nt`` > 0).
+        ``slot_toks`` [max_seqs+1] carries each slot's latest emitted token
+        ACROSS programs, so chunk t+1's decode feed never needs chunk t's
+        host readback (``feed_sel`` picks device feed vs fresh host token).
+        Statics: (k, nd, nt, sampled, has_tk, has_tp); jit specializes per
+        bucket set.
+        """
+        key = (k, nd, nt, sampled, has_tk, has_tp)
+        fn = self._fused_jits.get(key)
+        if fn is not None:
+            return fn
+        fwd = self.spec.ragged_forward_fn
+        ct = self.cfg.prefill_tile
+        max_seqs = self.cfg.max_seqs
+
+        def pick(logits, rng, temp, tk, tp_):
+            if not sampled:
+                return jnp.argmax(
+                    logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+            from deepspeed_tpu.inference.sampling import sample_tokens
+
+            toks, _ = sample_tokens(logits, rng, temp,
+                                    top_k=tk if has_tk else None,
+                                    top_p=tp_ if has_tp else None)
+            return toks
+
+        def chunk_fn(params, cache, slot_toks, tokens, slots, positions,
+                     feed_sel, dec_remaining, pf_last_mask, ts, tp, tv,
+                     block_tables, rng, temp, topk, topp):
+            if nd:
+                fed = jnp.where(feed_sel > 0, slot_toks[slots[:nd]],
+                                tokens[:nd])
+                tokens = tokens.at[:nd].set(fed)
+            if nt:
+                logits, cache = fwd(params, tokens, slots, positions,
+                                    block_tables, cache,
+                                    prefill_tiles=(nd, ts, tp, tv, ct))
+            else:
+                logits, cache = fwd(params, tokens, slots, positions,
+                                    block_tables, cache)
+            tok0 = pick(logits, rng, temp, topk, topp)
+            st = slot_toks
+            t_total = tokens.shape[0]
+            if t_total > nd:
+                # prompt-completing rows publish their first generated token
+                mask = pf_last_mask[nd:] > 0
+                sl_pf = jnp.where(mask, slots[nd:], max_seqs)
+                st = st.at[sl_pf].set(
+                    jnp.where(mask, tok0[nd:], st[sl_pf]))
+            if nd and k > 1:
+                def one(carry, i):
+                    cache, toks, pos = carry
+                    active = i < dec_remaining
+                    s = jnp.where(active, slots[:nd], max_seqs)
+                    lg, cache = fwd(params, toks, s, pos, block_tables, cache)
+                    r = jax.random.fold_in(rng, i)
+                    nxt = pick(lg, r, temp[:nd], topk[:nd], topp[:nd])
+                    # frozen rows keep their last token (feed stability)
+                    nxt = jnp.where(active, nxt, toks)
+                    return (cache, nxt, pos + 1), nxt
+
+                (cache, _, _), rest = jax.lax.scan(
+                    one, (cache, tok0[:nd], positions[:nd] + 1),
+                    jnp.arange(1, k))
+                dec_toks = jnp.concatenate([tok0[:nd][None], rest], axis=0)
+            else:
+                dec_toks = (tok0[:nd][None] if nd
+                            else jnp.zeros((1, 0), jnp.int32))
+            if nd:
+                last_i = jnp.clip(dec_remaining, 1, k) - 1
+                last_tok = dec_toks[last_i, jnp.arange(nd)]
+                st = st.at[slots[:nd]].set(last_tok)
+            return dec_toks, tok0, st, cache
+
+        fn = jax.jit(chunk_fn, donate_argnums=(1, 2))
+        self._fused_jits[key] = fn
+        return fn
+
+    def _dispatch_fused(self) -> bool:
+        """Schedule + dispatch ONE fused chunk from host state (no readback).
+        Returns False when nothing is schedulable."""
+        self._admit_queued()
+        cfg = self.cfg
+        k_max = cfg.fused_chunk
+        ct = cfg.prefill_tile if self._use_tiles else 0
+        budget = cfg.max_tokens_per_step
+
+        decs: list[tuple[_SeqState, int]] = []
+        for seq in list(self._running.values()):
+            if seq.finished or not seq.in_decode:
+                continue
+            rem = seq.max_new_tokens - (seq.pos - len(seq.prompt))
+            if rem <= 0:
+                continue
+            k_s = min(k_max, rem)
+            if not self._ensure_capacity(seq, seq.pos + k_s):
+                continue  # admitted seqs cannot hit this (reservation)
+            decs.append((seq, k_s))
+            if len(decs) >= min(budget, cfg.max_seqs):
+                break
+        nd = (0 if not decs
+              else next(b for b in self._dec_buckets if b >= len(decs)))
+
+        # prefill chunks after the decode region
+        chunks: list[tuple[_SeqState, int, int]] = []  # (seq, start, take)
+        if ct:
+            tile_chunks, nt = self._plan_prefill_tiles(nd, budget)
+            chunks = [(seq, nd + tile0 * ct, take)
+                      for seq, tile0, take in tile_chunks]
+            t_total = nd + nt * ct
+        else:
+            nt = 0
+            fill = nd
+            for seq in list(self._running.values()):
+                if seq.finished or seq.in_decode or fill >= budget:
+                    continue
+                take = min(budget - fill, len(seq.prompt) - seq.pos)
+                while take and not self._ensure_capacity(seq, seq.pos + take):
+                    take -= 1
+                if take <= 0:
+                    continue
+                chunks.append((seq, fill, take))
+                fill += take
+            t_total = (nd if fill == nd
+                       else next(b for b in self._buckets if b >= fill))
+        if not decs and not chunks:
+            return False
+
+        k = k_max if decs else 1
+        tokens = np.zeros(max(t_total, 1), np.int32)
+        slots = np.full(max(t_total, 1), cfg.max_seqs, np.int32)
+        positions = np.zeros(max(t_total, 1), np.int32)
+        feed_sel = np.zeros(max(nd, 1), np.int32)
+        dec_remaining = np.zeros(max(nd, 1), np.int32)
+        pf_last = np.zeros(max(t_total, 1), np.int32)
+        temp = np.zeros(max(t_total, 1), np.float32)
+        topk = np.zeros(max(t_total, 1), np.int32)
+        topp = np.ones(max(t_total, 1), np.float32)
+        sampled = False
+
+        for j, (seq, k_s) in enumerate(decs):
+            slots[j] = seq.slot
+            positions[j] = seq.pos
+            dec_remaining[j] = k_s
+            temp[j], topk[j], topp[j] = seq.temperature, seq.top_k, seq.top_p
+            sampled = sampled or seq.temperature > 0.0
+            if self._slot_feed[seq.slot]:
+                feed_sel[j] = 1
+            else:
+                gen_idx = seq.pos - len(seq.prompt)
+                if gen_idx > len(seq.generated) - 1 and gen_idx != -1:
+                    raise RuntimeError(
+                        "fused scheduler: host token unavailable and no "
+                        f"device feed for uid={seq.uid!r} (pos={seq.pos})")
+                tokens[j] = seq.token_at(seq.pos)
+
+        pf_done: list[tuple[int, _SeqState]] = []
+        ts = np.full(max(nt, 1), cfg.max_seqs, np.int32)
+        tpos = np.zeros(max(nt, 1), np.int32)
+        tval = np.zeros(max(nt, 1), np.int32)
+        for seq, start, take in chunks:
+            sl = slice(start, start + take)
+            tokens[sl] = seq.prompt[seq.pos:seq.pos + take]
+            slots[sl] = seq.slot
+            positions[sl] = np.arange(seq.pos, seq.pos + take, dtype=np.int32)
+            temp[sl], topk[sl], topp[sl] = (seq.temperature, seq.top_k,
+                                            seq.top_p)
+            sampled = sampled or seq.temperature > 0.0
+            if ct:
+                tile0 = (start - nd) // ct
+                for t in range(-(-take // ct)):
+                    ts[tile0 + t] = seq.slot
+                    tpos[tile0 + t] = seq.pos + t * ct
+                    tval[tile0 + t] = min(ct, take - t * ct)
+            if seq.pos + take == len(seq.prompt):
+                pf_last[start + take - 1] = 1
+                pf_done.append((start + take - 1, seq))
+            seq.pos += take
+
+        # telemetry: step-0 real tokens + scan-step active decode tokens
+        n0 = len(decs) + sum(c[2] for c in chunks)
+        active_scan = sum(k_s - 1 for _, k_s in decs)
+        self.tokens_scheduled += n0 + active_scan
+        self.tokens_padded += (t_total - n0) + (k - 1) * nd - active_scan
+
+        rng = jax.random.fold_in(self._dispatch_rng, self._chunk_counter)
+        self._chunk_counter += 1
+        fn = self._get_fused_chunk(k, nd, nt, sampled,
+                                   bool(topk.any()),
+                                   bool((topp < 1.0).any()))
+        dec_toks, tok0, self._slot_toks, self.cache = fn(
+            self.params, self.cache, self._slot_toks,
+            jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(positions),
+            jnp.asarray(feed_sel), jnp.asarray(dec_remaining),
+            jnp.asarray(pf_last), jnp.asarray(ts), jnp.asarray(tpos),
+            jnp.asarray(tval), jnp.asarray(self.block_tables), rng,
+            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+        )
+        self.dispatch_count += 1
+
+        participants: dict[int, _SeqState] = {}
+        for seq, k_s in decs:
+            seq.pos += k_s
+            self._slot_feed[seq.slot] = True
+            participants[seq.slot] = seq
+        for row, seq in pf_done:
+            self._slot_feed[seq.slot] = True
+            participants[seq.slot] = seq
+        for seq, _, _ in chunks:
+            participants[seq.slot] = seq
+        for seq in participants.values():
+            seq.refs += 1
+        self._inflight_chunks.append({
+            "dec_toks": dec_toks, "tok0": tok0,
+            "decs": decs, "pf_done": pf_done,
+            "participants": list(participants.values()),
+        })
+        return True
+
+    def _append_tokens(self, seq: _SeqState, toks, out: dict) -> None:
+        for t in toks:
+            if seq.finished:
+                break  # post-EOS speculation: discard
+            seq.generated.append(int(t))
+            out[seq.uid] = int(t)
+            self.tokens_emitted += 1
+
+    def _reconcile_oldest(self) -> dict:
+        """Read back the OLDEST in-flight chunk's tokens and fold them into
+        host state (EOS/max_new enforcement, deferred release)."""
+        rec = self._inflight_chunks.pop(0)
+        dec_toks = np.asarray(rec["dec_toks"])
+        tok0 = np.asarray(rec["tok0"])
+        out: dict = {}
+        for row, seq in rec["pf_done"]:
+            self._append_tokens(seq, [int(tok0[row])], out)
+        for j, (seq, k_s) in enumerate(rec["decs"]):
+            self._append_tokens(seq, dec_toks[:k_s, j], out)
+        for seq in rec["participants"]:
+            seq.refs -= 1
+            if seq.finished and seq.refs == 0 and seq.slot >= 0:
+                self._slot_feed[seq.slot] = False
+                self._release(seq)
+        return out
+
+    def _step_fused(self) -> dict:
+        """One fused-pipeline turn: keep the dispatch window full, reconcile
+        the oldest chunk when the window is full (or nothing new can be
+        dispatched). Bounded speculation: at most ``pipeline_depth`` chunks
+        of tokens are unreconciled at any time."""
+        dispatched = False
+        while len(self._inflight_chunks) < self.cfg.pipeline_depth:
+            if not self._dispatch_fused():
+                break
+            dispatched = True
+        if self._inflight_chunks and (
+                not dispatched
+                or len(self._inflight_chunks) >= self.cfg.pipeline_depth):
+            return self._reconcile_oldest()
+        if not dispatched and not self._inflight_chunks:
+            self._deadlock_guard(0)
+        return {}
+
+    def drain(self) -> dict:
+        """Reconcile every in-flight chunk (a flush point for callers that
+        need host-complete state)."""
+        out: dict = {}
+        while self._inflight_chunks:
+            out.update(self._reconcile_oldest())
+        return out
 
     def _schedule_decodes(self, budget: int, tokens, slots, positions,
                           emit) -> int:
@@ -420,15 +805,46 @@ class RaggedInferenceEngine:
             self._running[seq.slot] = seq
 
     def _emit_tokens(self, logits, emit) -> dict:
-        """Shared step epilogue: greedy-pick at the emit indices, extend the
-        sequences, release finished ones."""
+        """Shared step epilogue: pick at the emit indices (greedy, or the
+        request's sampling config), extend the sequences, release finished
+        ones."""
         out: dict = {}
         if emit:
             idx = np.asarray([i for i, _ in emit])
-            picked = np.asarray(jnp.argmax(logits[idx].astype(jnp.float32), axis=-1))
+            if any(seq.temperature > 0.0 for _, seq in emit):
+                # jitted (cached per active-filter set; specializes per emit
+                # count): eager sampling here would be ~a dozen separate
+                # dispatches on a path whose whole cost model is dispatch
+                # count, and unconditional top-k/top-p would sort the vocab
+                # twice per step even for plain-temperature requests
+                tk = np.asarray([s.top_k for _, s in emit], np.int32)
+                tp = np.asarray([s.top_p for _, s in emit], np.float32)
+                fkey = (bool(tk.any()), bool((tp < 1.0).any()))
+                if not hasattr(self, "_sample_jits"):
+                    self._sample_jits = {}
+                if fkey not in self._sample_jits:
+                    from deepspeed_tpu.inference.sampling import sample_tokens
+
+                    has_tk, has_tp = fkey
+                    self._sample_jits[fkey] = jax.jit(
+                        lambda lg, rng, t, tk, tp: sample_tokens(
+                            lg, rng, t,
+                            top_k=tk if has_tk else None,
+                            top_p=tp if has_tp else None)[0])
+                rng = jax.random.fold_in(self._dispatch_rng,
+                                         self._chunk_counter)
+                self._chunk_counter += 1
+                picked = np.asarray(self._sample_jits[fkey](
+                    logits[idx], rng,
+                    np.asarray([s.temperature for _, s in emit], np.float32),
+                    tk, tp))
+            else:
+                picked = np.asarray(
+                    jnp.argmax(logits[idx].astype(jnp.float32), axis=-1))
             for (_, seq), tok in zip(emit, picked):
                 seq.generated.append(int(tok))
                 out[seq.uid] = int(tok)
+                self.tokens_emitted += 1
                 if seq.finished:
                     self._release(seq)
         return out
@@ -448,10 +864,13 @@ class RaggedInferenceEngine:
 
     def step(self) -> dict:
         """One SplitFuse step. Returns {uid: token} for sequences that emitted
-        a token this step (under decode run-ahead: the LAST token of each
-        sequence's chunk; the full stream is in the per-sequence state)."""
+        a token this step (under decode run-ahead / the fused pipeline: the
+        LAST token of each sequence's chunk; the full stream is in the
+        per-sequence state)."""
         if not self.has_work:
             return {}
+        if self.cfg.fused_chunk >= 2:
+            return self._step_fused()
         # admission FIRST: a newly admitted sequence is in prefill, which
         # disables run-ahead for this step — so queued requests are admitted
         # within one step whenever a slot + pool reservation exist, and the
@@ -500,6 +919,7 @@ class RaggedInferenceEngine:
             jnp.asarray(positions[:bucket]),
             jnp.asarray(self.block_tables),
         )
+        self.dispatch_count += 1
         return self._emit_tokens(logits, emit)
 
     def _get_tiled_step(self, nd: int, nt: int):
@@ -535,41 +955,20 @@ class RaggedInferenceEngine:
                                        if b >= n_dec)
 
         # prefill chunks at tile-aligned offsets after the decode region
-        ntiles_cap = max(0, (budget - nd) // ct)
-        chunks: list[tuple[_SeqState, int, int]] = []  # (seq, rel_tile0, take)
-        tiles_used = 0
+        # (planner shared with the fused pipeline)
+        chunks, nt = self._plan_prefill_tiles(nd, budget)
         sched = 0
-        for seq in list(self._running.values()):
-            if seq.in_decode or tiles_used >= ntiles_cap:
-                continue
-            avail = (ntiles_cap - tiles_used) * ct
-            take = min(avail, len(seq.prompt) - seq.pos)
-            while take and not self._ensure_capacity(seq, seq.pos + take):
-                take -= 1  # partial chunk under pool pressure
-            if take <= 0:
-                continue
-            start = nd + tiles_used * ct
+        for seq, tile0, take in chunks:
+            start = nd + tile0 * ct
             tokens[start:start + take] = seq.prompt[seq.pos:seq.pos + take]
             slots[start:start + take] = seq.slot
             positions[start:start + take] = np.arange(
                 seq.pos, seq.pos + take, dtype=np.int32)
-            chunks.append((seq, tiles_used, take))
             seq.pos += take
             sched += take
-            tiles_used += -(-take // ct)
             if seq.pos == len(seq.prompt):
                 emit.append((start + take - 1, seq))
         self._deadlock_guard(n_dec + sched)
-
-        if tiles_used == 0:
-            nt = 0
-        else:
-            nt = 1
-            while nt < tiles_used:
-                nt *= 2
-            nt = min(nt, max(1, ntiles_cap))
-            if nt < tiles_used:  # cap can be non-power-of-2
-                nt = tiles_used
         total = nd + nt * ct
         # per-tile metadata (pad tiles: scratch row, valid=0)
         ts = np.full(max(nt, 1), self.cfg.max_seqs, np.int32)
@@ -594,6 +993,7 @@ class RaggedInferenceEngine:
             jnp.asarray(tv[:max(nt, 1)]),
             jnp.asarray(self.block_tables),
         )
+        self.dispatch_count += 1
         return self._emit_tokens(logits, emit)
 
     # ------------------------------------------------------------------ convenience

@@ -19,6 +19,7 @@ command generation only).
 
 from __future__ import annotations
 
+import json
 import os
 import shlex
 import shutil
@@ -174,33 +175,55 @@ class GKERunner(MultiNodeRunner):
 
     def __init__(self, script, script_args, job_name: str, num_nodes: int,
                  image: str, tpu_topology: str = "", accelerator: str = "",
-                 **kw):
+                 chips_per_node: int = 0, **kw):
         super().__init__(script, script_args, python="python", **kw)
         self.job_name = job_name
         self.num_nodes = num_nodes
         self.image = image
         self.tpu_topology = tpu_topology
         self.accelerator = accelerator
+        self.chips_per_node = chips_per_node
 
     def backend_exists(self) -> bool:
         return shutil.which("kubectl") is not None
 
+    def _chips_per_node(self) -> int:
+        """Per-node TPU chip request: explicit override, else derived from the
+        slice topology (product of dims / nodes), else the 4-chip-host default."""
+        if self.chips_per_node:
+            return int(self.chips_per_node)
+        if self.tpu_topology:
+            try:
+                total = 1
+                for d in self.tpu_topology.lower().split("x"):
+                    total *= int(d)
+                per = total // max(self.num_nodes, 1)
+                if per >= 1 and per * self.num_nodes == total:
+                    return per
+            except ValueError:
+                pass
+        return 4
+
     def get_manifest(self) -> str:
+        # json.dumps per scalar: JSON is a YAML subset, so every value —
+        # quotes, backslashes, newlines — lands in the manifest intact.
+        q = json.dumps
         args = " ".join(shlex.quote(a) for a in self.script_args)
         env_lines = "".join(
-            f"\n            - name: {k}\n              value: {v!r}"
+            f"\n            - name: {q(str(k))}\n              value: {q(str(v))}"
             for k, v in self.extra_env.items())
         selectors = ""
         if self.accelerator:
             selectors += (f"\n            cloud.google.com/gke-tpu-accelerator: "
-                          f"{self.accelerator}")
+                          f"{q(self.accelerator)}")
         if self.tpu_topology:
             selectors += (f"\n            cloud.google.com/gke-tpu-topology: "
-                          f"{self.tpu_topology}")
+                          f"{q(self.tpu_topology)}")
+        shell_cmd = f"{self.python} {self.script} {args}".strip()
         return f"""apiVersion: jobset.x-k8s.io/v1alpha2
 kind: JobSet
 metadata:
-  name: {self.job_name}
+  name: {q(self.job_name)}
 spec:
   replicatedJobs:
   - name: workers
@@ -215,13 +238,13 @@ spec:
             nodeSelector:{selectors if selectors else " {}"}
             containers:
             - name: worker
-              image: {self.image}
+              image: {q(self.image)}
               command: ["bash", "-c"]
-              args: ["{self.python} {self.script} {args}"]
+              args: [{q(shell_cmd)}]
               env:{env_lines if env_lines else " []"}
               resources:
                 limits:
-                  google.com/tpu: "4"
+                  google.com/tpu: {q(str(self._chips_per_node()))}
 """
 
     def get_cmd(self) -> list[list[str]]:

@@ -70,14 +70,22 @@ class ShardCtx:
     # zero_optimization.quantized_weights is on; applied to each scanned
     # layer's weight slice so the stage-3 gather rides int8
     qwz: Any = None
+    # ZeRO-Infinity param-offload hook (runtime/param_offload.py): installed
+    # when zero_optimization.offload_param.device != none; streams each
+    # scanned layer's host-resident weight slice into HBM + compute-casts it
+    param_stream: Any = None
 
     def layer_weights(self, lp: dict, dtype) -> dict:
         """Per-layer weight preparation, called first thing in layer bodies:
-        just-in-time WOQ dequantization (inference), then the qwZ quantized
+        just-in-time WOQ dequantization (inference), then the ZeRO-Infinity
+        host->HBM stream-in (which also compute-casts), then the qwZ quantized
         gather (stage-3 training) when installed and constraints are live."""
         from deepspeed_tpu.ops.quantizer import dequantize_layer
 
         lp = dequantize_layer(lp, dtype)
+        if (self.param_stream is not None
+                and not getattr(self, "_suspend_constraints", False)):
+            lp = self.param_stream(lp, dtype)
         if self.qwz is not None and not getattr(self, "_suspend_constraints", False):
             lp = self.qwz(lp, dtype)
         return lp

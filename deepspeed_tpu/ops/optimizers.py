@@ -15,11 +15,21 @@ lives inside the compiled train step.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable, NamedTuple
 
 import optax
 
 from deepspeed_tpu.config.config import OptimizerConfig
+
+
+class ZeroOneAdamState(NamedTuple):
+    """0/1 Adam state: ``vcount`` counts variance refreshes actually applied
+    (the sparse schedule makes it lag ``count``), used for b2 bias correction."""
+
+    count: Any
+    vcount: Any
+    mu: Any
+    nu: Any
 
 
 def _adam_args(p: dict) -> dict:
@@ -238,7 +248,8 @@ def scale_by_zero_one_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
     def init(params):
         mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
         nu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
-        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+        return ZeroOneAdamState(count=jnp.zeros([], jnp.int32),
+                                vcount=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
 
     def update(updates, state, params=None):
         del params
@@ -251,6 +262,7 @@ def scale_by_zero_one_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
         refresh = jnp.logical_and(count <= var_freeze_step,
                                   (count % jnp.maximum(interval, 1)) == 0)
         refresh = jnp.logical_or(refresh, count <= var_update_scaler)
+        vcount = state.vcount + refresh.astype(jnp.int32)
         mu = jax.tree_util.tree_map(
             lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
             state.mu, updates)
@@ -259,12 +271,13 @@ def scale_by_zero_one_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
                 refresh, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
             state.nu, updates)
         mc = 1 - b1 ** cf
-        # variance bias correction tracks the number of refreshes, which the
-        # sparse schedule makes step-dependent; clamp by the freeze horizon
-        vc = 1 - b2 ** jnp.minimum(cf, float(var_freeze_step))
+        # bias-correct the variance by the number of refreshes ACTUALLY
+        # applied (nu is an EMA over vcount samples, not count), otherwise
+        # v-hat is underestimated between sparse refreshes and steps inflate
+        vc = 1 - b2 ** jnp.maximum(vcount, 1).astype(jnp.float32)
         out = jax.tree_util.tree_map(
             lambda m, v: (m / mc) / (jnp.sqrt(v / vc) + eps), mu, nu)
-        return out, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+        return out, ZeroOneAdamState(count=count, vcount=vcount, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init, update)
 

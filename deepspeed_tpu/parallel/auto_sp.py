@@ -20,6 +20,9 @@ directly, or be written against ``ShardCtx.attention``.
 
 from __future__ import annotations
 
+import contextvars
+import threading
+
 import jax
 
 from deepspeed_tpu.comm.topology import AXIS_SEQ
@@ -27,11 +30,74 @@ from deepspeed_tpu.utils.logging import logger
 
 _WARNED = False
 
+# Which (mesh, mode) is active for the CURRENT thread/context. The global
+# patch on jax.nn.dot_product_attention is a passive dispatcher: a model
+# traced on a thread with no active auto_sp context goes straight to the
+# original implementation, so interleaved engines on different meshes never
+# leak shardings into each other.
+_ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
+    "auto_sp_active", default=None)
+_PATCH_LOCK = threading.Lock()
+_PATCH_DEPTH = 0
+_ORIG = None
+
+
+def _dispatch(query, key, value, bias=None, mask=None, *args,
+              is_causal: bool = False, **kwargs):
+    global _WARNED
+    orig = _ORIG
+    active = _ACTIVE.get()
+    if active is None:
+        return orig(query, key, value, bias, mask, *args,
+                    is_causal=is_causal, **kwargs)
+    mesh, mode = active
+    sp = mesh.shape.get(AXIS_SEQ, 1) if mesh is not None else 1
+    if sp <= 1:
+        return orig(query, key, value, bias, mask, *args,
+                    is_causal=is_causal, **kwargs)
+    if bias is not None or mask is not None:
+        # a seq-sharded bias/mask would need resharding alongside the
+        # activations; fall back loudly rather than compute nonsense
+        if not _WARNED:
+            _WARNED = True
+            logger.warning(
+                "auto_sp: dot_product_attention called with "
+                "bias/mask — not sequence-parallelized (gathered "
+                "attention instead)")
+        return orig(query, key, value, bias, mask, *args,
+                    is_causal=is_causal, **kwargs)
+    if mode == "ring":
+        unsupported = [k for k, v in kwargs.items()
+                       if k != "scale" and v is not None]
+        if args or unsupported:
+            # length masks / local windows / implementation pins:
+            # the ring kernel has no equivalents — fall back loudly
+            if not _WARNED:
+                _WARNED = True
+                logger.warning(
+                    "auto_sp(ring): unsupported dot_product_attention "
+                    "options %s — gathered attention instead",
+                    unsupported or "positional")
+            return orig(query, key, value, bias, mask, *args,
+                        is_causal=is_causal, **kwargs)
+        from deepspeed_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(query, key, value, mesh,
+                              causal=is_causal,
+                              scale=kwargs.get("scale"))
+    from deepspeed_tpu.parallel.ulysses import ulysses_attention
+
+    local = lambda q, k, v: orig(  # noqa: E731
+        q, k, v, None, None, *args, is_causal=is_causal, **kwargs)
+    return ulysses_attention(query, key, value, mesh,
+                             causal=is_causal, local_fn=local)
+
 
 class auto_sp:
-    """Context manager patching ``jax.nn.dot_product_attention`` to run
-    sequence-parallel over ``mesh``. Active only inside the ``with`` block —
-    hold it open around model tracing (the engine does this when
+    """Context manager routing ``jax.nn.dot_product_attention`` through
+    sequence-parallel attention over ``mesh``. Active only inside the ``with``
+    block AND only for the entering thread/context (a ``ContextVar`` carries
+    the mesh) — hold it open around model tracing (the engine does this when
     ``sequence_parallel.auto`` is on)."""
 
     def __init__(self, mesh, mode: str = "ulysses"):
@@ -39,65 +105,30 @@ class auto_sp:
             raise ValueError(f"auto_sp mode must be ulysses|ring, got {mode!r}")
         self.mesh = mesh
         self.mode = mode
-        self._orig = None
-
-    def _wrapped(self, orig):
-        mesh, mode = self.mesh, self.mode
-
-        def dot_product_attention(query, key, value, bias=None, mask=None,
-                                  *args, is_causal: bool = False, **kwargs):
-            global _WARNED
-            sp = mesh.shape.get(AXIS_SEQ, 1) if mesh is not None else 1
-            if sp <= 1:
-                return orig(query, key, value, bias, mask, *args,
-                            is_causal=is_causal, **kwargs)
-            if bias is not None or mask is not None:
-                # a seq-sharded bias/mask would need resharding alongside the
-                # activations; fall back loudly rather than compute nonsense
-                if not _WARNED:
-                    _WARNED = True
-                    logger.warning(
-                        "auto_sp: dot_product_attention called with "
-                        "bias/mask — not sequence-parallelized (gathered "
-                        "attention instead)")
-                return orig(query, key, value, bias, mask, *args,
-                            is_causal=is_causal, **kwargs)
-            if mode == "ring":
-                unsupported = [k for k, v in kwargs.items()
-                               if k != "scale" and v is not None]
-                if args or unsupported:
-                    # length masks / local windows / implementation pins:
-                    # the ring kernel has no equivalents — fall back loudly
-                    if not _WARNED:
-                        _WARNED = True
-                        logger.warning(
-                            "auto_sp(ring): unsupported dot_product_attention "
-                            "options %s — gathered attention instead",
-                            unsupported or "positional")
-                    return orig(query, key, value, bias, mask, *args,
-                                is_causal=is_causal, **kwargs)
-                from deepspeed_tpu.parallel.ring_attention import ring_attention
-
-                return ring_attention(query, key, value, mesh,
-                                      causal=is_causal,
-                                      scale=kwargs.get("scale"))
-            from deepspeed_tpu.parallel.ulysses import ulysses_attention
-
-            local = lambda q, k, v: orig(  # noqa: E731
-                q, k, v, None, None, *args, is_causal=is_causal, **kwargs)
-            return ulysses_attention(query, key, value, mesh,
-                                     causal=is_causal, local_fn=local)
-
-        return dot_product_attention
+        self._token = None
 
     def __enter__(self):
-        self._orig = jax.nn.dot_product_attention
-        jax.nn.dot_product_attention = self._wrapped(self._orig)
+        global _PATCH_DEPTH, _ORIG
+        with _PATCH_LOCK:
+            if _PATCH_DEPTH == 0:
+                if jax.nn.dot_product_attention is not _dispatch:
+                    _ORIG = jax.nn.dot_product_attention
+                jax.nn.dot_product_attention = _dispatch
+            _PATCH_DEPTH += 1
+        self._token = _ACTIVE.set((self.mesh, self.mode))
         return self
 
     def __exit__(self, *exc):
-        jax.nn.dot_product_attention = self._orig
-        self._orig = None
+        global _PATCH_DEPTH
+        _ACTIVE.reset(self._token)
+        self._token = None
+        with _PATCH_LOCK:
+            _PATCH_DEPTH -= 1
+            if _PATCH_DEPTH == 0:
+                # restore the attribute but KEEP _ORIG: stale references to
+                # the dispatcher (captured while a context was open) must
+                # keep resolving to the original, not crash on None
+                jax.nn.dot_product_attention = _ORIG
         return False
 
 

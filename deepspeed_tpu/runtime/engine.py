@@ -171,7 +171,80 @@ class Engine:
                     f"{zero.qwz_block}) over fsdp={topo.size('fsdp')}",
                     ranks=[0])
 
+        # ZeRO-Infinity parameter offload (reference
+        # runtime/zero/parameter_offload.py:117 DeepSpeedZeRoOffload +
+        # swap_tensor/partitioned_param_swapper.py:37): master params live in
+        # host DRAM (pinned_host memory kind) and stream through HBM per
+        # scanned layer — see runtime/param_offload.py for the mechanism.
+        from deepspeed_tpu.runtime import offload as offload_mod
+
+        self._param_offload: str = zero.offload_param.device
+        self._param_storage = None        # host-kind storage shardings
+        self._param_offload_mask = None   # which leaves offload
+        if self._param_offload != "none":
+            from deepspeed_tpu.config.config import ConfigError
+            from deepspeed_tpu.runtime import param_offload as po_mod
+
+            if self._param_offload == "nvme":
+                raise ConfigError(
+                    "zero_optimization.offload_param.device='nvme' is not "
+                    "implemented (params would need per-layer NVMe fetch "
+                    "inside the compiled step); use device='cpu' — the host-"
+                    "DRAM tier streams the layer stack through HBM per layer")
+            if self.zero_stage != 3:
+                raise ConfigError(
+                    "offload_param streams the stage-3 scanned layer stack; "
+                    f"it requires zero_optimization.stage=3 (got {self.zero_stage})")
+            if topo.size("pipeline") > 1:
+                raise ConfigError(
+                    "offload_param does not compose with pipeline parallelism "
+                    "(the pipeline owns the layer-stack slicing the host "
+                    "stream rides on)")
+            if zero.quantized_gradients:
+                raise ConfigError(
+                    "offload_param does not compose with quantized_gradients "
+                    "(device_put to named shardings is unavailable inside the "
+                    "qgZ manual region)")
+            if not config.activation_checkpointing.enabled:
+                raise ConfigError(
+                    "offload_param requires activation_checkpointing: without "
+                    "rematerialization every streamed layer's weights are "
+                    "saved for backward and the full model re-materializes "
+                    "in HBM, silently defeating the offload")
+            if zero.offload_optimizer.device not in ("cpu", "nvme"):
+                raise ConfigError(
+                    "offload_param requires offload_optimizer.device cpu|nvme "
+                    "(optimizer state is ~2x the params that no longer fit "
+                    "in HBM, and the windowed update walk is what streams "
+                    "the master params through the optimizer)")
+            host_ok = offload_mod.supports_memory_kinds(topo.mesh)
+            abstract = jax.eval_shape(self.model_spec.init_fn,
+                                      jax.random.PRNGKey(0))
+            self._param_storage, self._param_offload_mask = (
+                po_mod.storage_shardings(
+                    self.plan.param_shardings, abstract,
+                    zero.persistence_threshold, host_ok))
+            specs = self.plan.param_specs
+            if isinstance(specs, dict) and "layers" in specs:
+                self.shard_ctx.param_stream = po_mod.build_layer_stream_hook(
+                    topo.mesh, specs["layers"],
+                    self._param_offload_mask["layers"])
+            else:
+                log_dist(
+                    "offload_param: model has no stacked 'layers' subtree — "
+                    "whole-leaf streaming only (no per-layer window)",
+                    ranks=[0])
+            n_off = sum(jax.tree_util.tree_leaves(self._param_offload_mask))
+            log_dist(
+                f"offload_param: {n_off} param leaves host-resident, streamed "
+                "per scanned layer"
+                + ("" if host_ok else
+                   " (no host tier on this backend; streaming path only)"),
+                ranks=[0])
+
         # ---- params (fp32 master), placed per plan (reference zero.Init analog)
+        param_placement = (self._param_storage if self._param_storage is not None
+                           else self.plan.param_shardings)
         seed = seed if seed is not None else config.seed
         init_rng = jax.random.PRNGKey(seed)
         if initial_params is not None:
@@ -183,10 +256,10 @@ class Engine:
                 if jnp.issubdtype(x.dtype, jnp.floating) else x,
                 initial_params,
             )
-            self.params = jax.device_put(initial_params, self.plan.param_shardings)
+            self.params = jax.device_put(initial_params, param_placement)
         else:
             self.params = jax.jit(
-                self.model_spec.init_fn, out_shardings=self.plan.param_shardings
+                self.model_spec.init_fn, out_shardings=param_placement
             )(init_rng)
 
         # ---- optimizer (lr=1.0; schedule applied inside the step for exact
@@ -207,9 +280,15 @@ class Engine:
         from deepspeed_tpu.runtime import offload as offload_mod
 
         self._offload_mode: str | None = None
+        self._opt_host_ok = False
         self._groups: list[list[int]] | None = None
         self._swapper = None
         param_leaves, self._param_treedef = jax.tree_util.tree_flatten(self.params)
+        # leaf-level live/storage shardings: the group walks stream offloaded
+        # master params through HBM with these targets
+        self._param_dev_leaf_sh = jax.tree_util.tree_leaves(
+            self.plan.param_shardings)
+        self._param_store_leaf_sh = jax.tree_util.tree_leaves(param_placement)
         dev = zero.offload_optimizer.device
         if dev in ("cpu", "nvme"):
             self._offload_mode = dev
@@ -220,6 +299,7 @@ class Engine:
             from deepspeed_tpu.parallel.partition import grouped_opt_state_shardings
 
             host_ok = offload_mod.supports_memory_kinds(topo.mesh)
+            self._opt_host_ok = host_ok
             # SuperOffload mixed residency (reference superoffload_stage3.py
             # subgroup_to_device): the first hbm_resident_fraction of groups
             # skip the host tier entirely — no stream round trip for the
@@ -514,9 +594,24 @@ class Engine:
             filt, self.plan.grad_specs,
             is_leaf=lambda x: isinstance(x, PartitionSpec))
 
+    def _cast_params(self, params):
+        """Compute-dtype view of the master params. Under parameter offload
+        the stacked layers stay host-resident fp32 (the ShardCtx.param_stream
+        hook streams+casts each scan slice); other offloaded leaves stream
+        whole; everything else casts in place."""
+        if self._param_offload_mask is not None:
+            from deepspeed_tpu.runtime import param_offload as po_mod
+
+            return po_mod.cast_params_streaming(
+                params, self._param_offload_mask, self.plan.param_shardings,
+                self.config.compute_dtype,
+                layers_key=("layers" if self.shard_ctx.param_stream is not None
+                            else None))
+        return precision.cast_to_compute(params, self.config.compute_dtype)
+
     def _microbatch_grads(self, params, mb, rng, scale, step=None):
         """Scaled-loss grads for one microbatch, fp32, ZeRO-sharded."""
-        cparams = precision.cast_to_compute(params, self.config.compute_dtype)
+        cparams = self._cast_params(params)
 
         def scaled_loss(cp):
             if self._compression is not None and step is not None:
@@ -584,12 +679,29 @@ class Engine:
         optimizer owns them — see ``zenflow.restore_hot_opt_state``)."""
         from deepspeed_tpu.runtime import offload as offload_mod
 
+        param_hosted = self._param_storage is not None
         new_p = list(p_leaves)
         new_opt = []
+        # Windowing on TPU is MEMORY-PRESSURE-DRIVEN: the groups carry no
+        # data dependencies, so when HBM is abundant XLA's latency-hiding
+        # scheduler issues several groups' host->HBM copies ahead (measured:
+        # the full state when it trivially fits); as the program's memory
+        # bound tightens the scheduler serializes copies behind compute and
+        # the peak holds ~a group window. Forcing the window with
+        # optimization_barrier was measured STRICTLY worse here (mixed
+        # host/device operands materialize extra device copies, +20% temp and
+        # ~2x step time) — the declarative form wins, so the window is left
+        # to the scheduler. The offload bench rung trains a model whose fp32
+        # state exceeds HBM, which only completes if this actually windows.
         for g, idx in enumerate(self._groups):
             pg = tuple(p_leaves[i] for i in idx)
             gg = tuple(g_leaves[i] for i in idx)
             dev_sh, store_sh = self._group_shardings[g]
+            if param_hosted:
+                # ZeRO-Infinity: master params stream through HBM for the
+                # update group-by-group, exactly like the optimizer state
+                pg = tuple(jax.device_put(p, self._param_dev_leaf_sh[i])
+                           for p, i in zip(pg, idx))
             state = offload_mod.stream_in(opt_groups[g], dev_sh)
             updates, new_state = self.optimizer.update(gg, state, pg)
             newp = optax.apply_updates(
@@ -601,6 +713,9 @@ class Engine:
                     new_state, state, tuple(hot_idx[i] for i in idx),
                     self.config.zero_optimization.zenflow.block)
             new_opt.append(offload_mod.stream_out(new_state, store_sh))
+            if param_hosted:
+                newp = tuple(jax.device_put(p, self._param_store_leaf_sh[i])
+                             for p, i in zip(newp, idx))
             for j, i in enumerate(idx):
                 new_p[i] = newp[j]
         return new_p, new_opt
@@ -784,13 +899,42 @@ class Engine:
 
         return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2))
 
-    def _build_group_apply_fn(self):
-        """Sub-group optimizer apply: takes a group's param/grad leaf tuples +
-        its NVMe-loaded state, returns the updated leaves and state (jit
-        specializes per group's shapes automatically). ``factor`` folds
-        unscale+clip into one multiplier (coef / (scale * n_micro))."""
+    def _group_apply(self, g: int):
+        """Sub-group optimizer apply for group ``g`` (NVMe walk): takes the
+        group's param/grad leaf tuples + its NVMe-loaded state, returns the
+        updated leaves and state. ``factor`` folds unscale+clip into one
+        multiplier (coef / (scale * n_micro)). Under parameter offload the
+        group's host-resident masters stream through HBM for the update and
+        back (per-group jit: the stream targets are group-specific)."""
+        if self._group_apply_jit is None:
+            self._group_apply_jit = {}
+        param_hosted = self._param_storage is not None
+        # with no group-specific sharding targets (plain NVMe tier) the
+        # program is identical for every group: ONE shared jit object, so
+        # jax's shape-level cache dedups compiles across uniform groups
+        cache_key = (g if (param_hosted or self._offload_mode == "cpu")
+                     else "shared")
+        fn = self._group_apply_jit.get(cache_key)
+        if fn is not None:
+            return fn
+        idx = self._groups[g]
+        in_sh = tuple(self._param_dev_leaf_sh[i] for i in idx) \
+            if param_hosted else None
+        out_sh = tuple(self._param_store_leaf_sh[i] for i in idx) \
+            if param_hosted else None
+        # cpu tier: the state argument arrives as pinned-host jax arrays and
+        # streams through HBM inside this (per-group) program; nvme tier:
+        # the state arrives as np host buffers from the swapper
+        state_sh = (self._group_shardings[g]
+                    if self._offload_mode == "cpu" else None)
 
         def apply_g(pg, state, gg, factor, lr, finite):
+            if param_hosted:
+                pg = tuple(jax.device_put(p, s) for p, s in zip(pg, in_sh))
+            if state_sh is not None:
+                from deepspeed_tpu.runtime import offload as offload_mod
+
+                state = offload_mod.stream_in(state, state_sh[0])
             gg = jax.tree_util.tree_map(lambda x: x * factor, gg)
             updates, new_state = self.optimizer.update(gg, state, pg)
             newp = optax.apply_updates(
@@ -801,9 +945,94 @@ class Engine:
             # overflowed step writes back the unchanged state
             newp = _tree_select(finite, newp, pg)
             new_state = _tree_select(finite, new_state, state)
+            if state_sh is not None:
+                from deepspeed_tpu.runtime import offload as offload_mod
+
+                new_state = offload_mod.stream_out(new_state, state_sh[1])
+            if param_hosted:
+                newp = tuple(jax.device_put(p, s) for p, s in zip(newp, out_sh))
             return newp, new_state
 
-        return jax.jit(apply_g, donate_argnums=(1,))
+        fn = jax.jit(apply_g, donate_argnums=(1,))
+        self._group_apply_jit[cache_key] = fn
+        return fn
+
+    def _get_pre_jit(self):
+        """ONE fused program for the split-step prologue (norm + overflow +
+        clip + lr). Eager per-leaf jnp ops here would each dispatch a tiny
+        8-device program with its own collective rendezvous — racing the
+        AIO threads, that starves nondeterministically on a 1-core host
+        (observed as 0%-CPU wedges in the test suite)."""
+        if getattr(self, "_pre_jit", None) is None:
+            gas = jnp.float32(self.gas)
+            clip = self.config.gradient_clipping
+
+            def pre_fn(grad_sum, scale, step):
+                denom = scale * gas
+                gnorm = _global_norm(grad_sum) / denom
+                finite = precision.grads_finite(grad_sum)
+                coef = (jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                        if clip > 0 else jnp.float32(1.0))
+                return gnorm, finite, coef / denom, self.lr_schedule(step)
+
+            self._pre_jit = jax.jit(pre_fn)
+        return self._pre_jit
+
+    def _train_batch_grouped(self, batch: dict):
+        """Split step for the HOST-pinned tier (and/or parameter offload):
+        fwd/bwd in one program, then ONE PROGRAM PER SUB-GROUP for the
+        optimizer walk — the reference's per-subgroup step
+        (``stage3.py:2360 _prepare_sub_group`` + CPU-Adam-per-group), and the
+        only layout whose peak HBM is truly one group's window: inside a
+        single fused program the groups carry no data dependencies, so XLA's
+        scheduler is free to issue every group's host->HBM copy concurrently —
+        measured on TPU as the full optimizer state materializing in HBM and,
+        past HBM capacity, a compile-time OOM. Program boundaries are the
+        fence. The overflow verdict stays a device scalar inside every
+        per-group program (speculative dispatch, no host sync)."""
+        if self._grads_jit is None:
+            self._grads_jit = self._build_grads_fn()
+        dev_batch = self._put_gas_batch(batch)
+        self.tput_timer.start()
+        loss, grad_sum = self._grads_jit(
+            self.params, self.scale_state, jnp.int32(self.global_steps),
+            self._train_rng, dev_batch,
+        )
+        gnorm, finite_dev, factor, lr = self._get_pre_jit()(
+            grad_sum, self.scale_state.scale, jnp.int32(self.global_steps))
+        p_leaves = jax.tree_util.tree_leaves(self.params)
+        g_leaves = jax.tree_util.tree_leaves(grad_sum)
+        new_p_leaves = list(p_leaves)
+        new_opt = []
+        for g, idx in enumerate(self._groups):
+            pg = tuple(p_leaves[i] for i in idx)
+            gg = tuple(g_leaves[i] for i in idx)
+            newp, new_state = self._group_apply(g)(
+                pg, self.opt_state[g], gg, factor, lr, finite_dev)
+            new_opt.append(new_state)
+            for j, i in enumerate(idx):
+                new_p_leaves[i] = newp[j]
+        self.params = jax.tree_util.tree_unflatten(
+            self._param_treedef, new_p_leaves)
+        self.opt_state = new_opt
+        step_scale = self.scale_state.scale
+        self.scale_state = precision.update_loss_scale(
+            self.scale_state, finite_dev, self.config.fp16)
+        metrics = {
+            "loss": loss,
+            "grad_norm": gnorm,
+            "lr": lr,
+            "loss_scale": step_scale,
+            "skipped": jnp.logical_not(finite_dev),
+        }
+        # bounded async window (same discipline as the fused path)
+        self._inflight.append(metrics["loss"])
+        if len(self._inflight) > self._max_inflight:
+            jax.block_until_ready(self._inflight.pop(0))
+        self.tput_timer.stop(global_step=True)
+        self._after_step(metrics)
+        self.micro_steps += self.gas
+        return metrics["loss"]
 
     def _train_batch_nvme(self, batch: dict):
         """Full step with NVMe-resident optimizer state (reference
@@ -823,25 +1052,7 @@ class Engine:
             self._train_rng, dev_batch,
         )
         cfg = self.config
-        # ONE fused program for the step prologue (norm + overflow + clip +
-        # lr). Eager per-leaf jnp ops here would each dispatch a tiny
-        # 8-device program with its own collective rendezvous — racing the
-        # AIO threads, that starves nondeterministically on a 1-core host
-        # (observed as 0%-CPU wedges in the test suite).
-        if getattr(self, "_nvme_pre_jit", None) is None:
-            gas = jnp.float32(self.gas)
-            clip = cfg.gradient_clipping
-
-            def pre_fn(grad_sum, scale, step):
-                denom = scale * gas
-                gnorm = _global_norm(grad_sum) / denom
-                finite = precision.grads_finite(grad_sum)
-                coef = (jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                        if clip > 0 else jnp.float32(1.0))
-                return gnorm, finite, coef / denom, self.lr_schedule(step)
-
-            self._nvme_pre_jit = jax.jit(pre_fn)
-        gnorm, finite_dev, factor, lr = self._nvme_pre_jit(
+        gnorm, finite_dev, factor, lr = self._get_pre_jit()(
             grad_sum, self.scale_state.scale, jnp.int32(self.global_steps))
         speculative = cfg.zero_optimization.offload_optimizer.super_offload
         if speculative:
@@ -860,8 +1071,6 @@ class Engine:
             g_leaves = jax.tree_util.tree_leaves(grad_sum)
             new_p_leaves = list(p_leaves)
             groups = self._groups
-            if self._group_apply_jit is None:
-                self._group_apply_jit = self._build_group_apply_fn()
             prev_write_keys: list = []
             for g, idx in enumerate(groups):
                 if g + 1 < len(groups):
@@ -871,7 +1080,7 @@ class Engine:
                     f"opt_g{g}", self._nvme_templates[g])
                 pg = tuple(p_leaves[i] for i in idx)
                 gg = tuple(g_leaves[i] for i in idx)
-                newp, new_state = self._group_apply_jit(
+                newp, new_state = self._group_apply(g)(
                     pg, state, gg, factor, lr, finite_dev)
                 # windowed write pipeline: free group g-1's write buffers
                 # before snapshotting group g, so host RAM holds ~one group
@@ -1081,7 +1290,7 @@ class Engine:
 
     def _build_eval_fn(self):
         def eval_fn(params, batch, rng):
-            cparams = precision.cast_to_compute(params, self.config.compute_dtype)
+            cparams = self._cast_params(params)
             return self.model_spec.loss_fn(cparams, batch, rng)
 
         return jax.jit(eval_fn)
@@ -1135,6 +1344,14 @@ class Engine:
             return self._train_batch_nvme(batch)
         if self._zenflow:
             return self._train_batch_zenflow(batch)
+        if (self._offload_mode == "cpu" and not self._qgrad
+                and (self._opt_host_ok or self._param_offload != "none")):
+            # a REAL pinned-host tier (or offloaded params): per-group
+            # programs so peak HBM is one group's window (see
+            # _train_batch_grouped); the in-jit walk below remains for
+            # backends where the host kind is a no-op (CPU test mesh) and
+            # for qgZ, whose int8 reduction lives in the fused step program
+            return self._train_batch_grouped(batch)
         if self._train_batch_jit is None:
             self._train_batch_jit = self._build_train_batch_fn()
         dev_batch = self._put_gas_batch(batch)
@@ -1254,7 +1471,7 @@ class Engine:
             stability=e.stability,
             gas_boundary_resolution=e.gas_boundary_resolution,
             layer_name=e.layer_name, layer_num=e.layer_num)
-        cparams = precision.cast_to_compute(self.params, self.config.compute_dtype)
+        cparams = self._cast_params(self.params)
         return probe.compute_eigenvalue(
             self.model_spec.loss_fn, cparams,
             self._put_microbatch(batch), self._next_rng())
@@ -1513,7 +1730,10 @@ class Engine:
             params_host = ser.arrays_to_tree(
                 jax.tree_util.tree_map(np.asarray, self.params), state["model"]
             )
-            self.params = jax.device_put(params_host, self.plan.param_shardings)
+            self.params = jax.device_put(
+                params_host,
+                self._param_storage if self._param_storage is not None
+                else self.plan.param_shardings)
             if load_optimizer_states and "optimizer" in state:
                 opt_arrays = {k: v for k, v in state["optimizer"].items()
                               if not k.startswith("__scale__")}

@@ -94,44 +94,72 @@ class HybridEngine(Engine):
         return self._eval_params
 
     # -------------------------------------------------------------- generate
-    def _build_generate(self, batch: int, prompt_len: int, max_new: int, sample: bool):
+    def _build_generate(self, batch: int, prompt_len: int, max_new: int,
+                        sample: bool, use_penalty: bool, has_tk: bool,
+                        has_tp: bool):
         decode = self.model_spec.decode_fn
         init_cache = self.model_spec.init_cache_fn
         dtype = self.inference_dtype
 
-        def generate_fn(cparams, tokens, rng, temperature):
+        def generate_fn(cparams, tokens, rng, temperature, top_k, top_p,
+                        rep_pen):
+            from deepspeed_tpu.inference.sampling import (
+                sample_tokens,
+                update_seen,
+            )
+
             cache = init_cache(batch, prompt_len + max_new, dtype)
             logits, cache = decode(cparams, tokens, cache, 0)
             last = logits[:, prompt_len - 1].astype(jnp.float32)
+            vocab = last.shape[-1]
+            seen0 = (jnp.zeros((batch, vocab), jnp.bool_)
+                     .at[jnp.arange(batch)[:, None], tokens].set(True)
+                     if use_penalty else jnp.zeros((batch, 1), jnp.bool_))
 
             def step(carry, i):
-                last, cache = carry
+                last, cache, seen = carry
                 r = jax.random.fold_in(rng, i)
-                tok = (jax.random.categorical(r, last / temperature) if sample
-                       else jnp.argmax(last, axis=-1)).astype(jnp.int32)
-                lp = jax.nn.log_softmax(last, axis=-1)
-                tok_lp = jnp.take_along_axis(lp, tok[:, None], axis=-1)[:, 0]
+                # the returned logprob is of the token under the FINAL
+                # (tempered + filtered + penalized) distribution — the
+                # behavior policy a PPO/GRPO importance ratio needs
+                tok, tok_lp = sample_tokens(
+                    last, r, temperature if sample else jnp.float32(0.0),
+                    top_k=top_k if has_tk else None,
+                    top_p=top_p if has_tp else None,
+                    repetition_penalty=rep_pen if use_penalty else None,
+                    seen_mask=seen if use_penalty else None)
+                if use_penalty:
+                    seen = update_seen(seen, tok)
                 logits, cache = decode(cparams, tok[:, None], cache, prompt_len + i)
-                return (logits[:, 0].astype(jnp.float32), cache), (tok, tok_lp)
+                return ((logits[:, 0].astype(jnp.float32), cache, seen),
+                        (tok, tok_lp))
 
-            (_, _), (toks, lps) = jax.lax.scan(step, (last, cache), jnp.arange(max_new))
+            (_, _, _), (toks, lps) = jax.lax.scan(
+                step, (last, cache, seen0), jnp.arange(max_new))
             return toks.T, lps.T  # [B, max_new] tokens + logprobs
 
         return jax.jit(generate_fn)
 
     def generate(self, input_ids, max_new_tokens: int = 64, temperature: float = 0.0,
-                 seed: int | None = None, return_logprobs: bool = False):
+                 seed: int | None = None, return_logprobs: bool = False,
+                 top_k: int = 0, top_p: float = 1.0,
+                 repetition_penalty: float = 1.0):
         """Rollout generation on the CURRENT training weights."""
         input_ids = np.asarray(input_ids)
         b, t = input_ids.shape
         sample = temperature > 0.0
-        key = (b, t, max_new_tokens, sample)
+        use_penalty = repetition_penalty != 1.0
+        has_tk, has_tp = top_k > 0, top_p < 1.0
+        key = (b, t, max_new_tokens, sample, use_penalty, has_tk, has_tp)
         if key not in self._gen_cache:
-            self._gen_cache[key] = self._build_generate(b, t, max_new_tokens, sample)
+            self._gen_cache[key] = self._build_generate(
+                b, t, max_new_tokens, sample, use_penalty, has_tk, has_tp)
         rng = jax.random.PRNGKey(seed) if seed is not None else self._next_rng()
         toks, lps = self._gen_cache[key](
             self.eval_params, jnp.asarray(input_ids), rng,
             jnp.float32(max(temperature, 1e-6)),
+            jnp.int32(top_k), jnp.float32(top_p),
+            jnp.float32(repetition_penalty),
         )
         full = np.concatenate([input_ids, np.asarray(toks)], axis=1)
         if return_logprobs:
@@ -141,7 +169,9 @@ class HybridEngine(Engine):
     # ------------------------------------------------------------- rollouts
     def generate_rollouts(self, prompts, rollout_batch_size: int = 8,
                           max_new_tokens: int = 64, temperature: float = 1.0,
-                          seed: int | None = None, pad_token_id: int = 0):
+                          seed: int | None = None, pad_token_id: int = 0,
+                          top_k: int = 0, top_p: float = 1.0,
+                          repetition_penalty: float = 1.0):
         """Batched rollout over a prompt SET (reference
         ``hybrid_engine_rollout.py``): prompts are grouped by EXACT length —
         padding between a prompt and its continuation would make the policy
@@ -168,7 +198,8 @@ class HybridEngine(Engine):
                 full, lps = self.generate(
                     batch, max_new_tokens=max_new_tokens,
                     temperature=temperature, seed=base_seed + call,
-                    return_logprobs=True)
+                    return_logprobs=True, top_k=top_k, top_p=top_p,
+                    repetition_penalty=repetition_penalty)
                 call += 1
                 for j, i in enumerate(idx):
                     out[i] = {
@@ -206,24 +237,36 @@ class HybridEngine(Engine):
                         tokens=input_ids.copy(), max_len=max_len)
 
     def decode_more(self, state: GenState, n_tokens: int,
-                    temperature: float = 0.0, seed: int | None = None) -> GenState:
+                    temperature: float = 0.0, seed: int | None = None,
+                    top_k: int = 0, top_p: float = 1.0) -> GenState:
         """Extend a ``GenState`` by ``n_tokens`` greedy/sampled tokens in one
-        jitted scan; the incoming cache buffer is donated to the step."""
+        jitted scan; the incoming cache buffer is donated to the step.
+        (Repetition penalty is not offered here: the occurrence mask would
+        have to persist in ``GenState`` across calls; use ``generate``.)"""
         if state.pos + n_tokens > state.max_len:
             raise ValueError(
                 f"decode_more past max_len: {state.pos}+{n_tokens} > {state.max_len}")
         b = state.tokens.shape[0]
         decode = self.model_spec.decode_fn
         sample = temperature > 0.0
-        key = (b, n_tokens, state.max_len, sample)
+        has_tk, has_tp = top_k > 0, top_p < 1.0
+        key = (b, n_tokens, state.max_len, sample, has_tk, has_tp)
         if key not in self._decode_cache:
 
-            def decode_fn(cparams, last, cache, pos, rng, temperature):
+            def decode_fn(cparams, last, cache, pos, rng, temperature,
+                          top_k, top_p):
+                from deepspeed_tpu.inference.sampling import sample_tokens
+
                 def step(carry, i):
                     last, cache = carry
                     r = jax.random.fold_in(rng, i)
-                    tok = (jax.random.categorical(r, last / temperature) if sample
-                           else jnp.argmax(last, axis=-1)).astype(jnp.int32)
+                    if sample:
+                        tok, _ = sample_tokens(
+                            last, r, temperature,
+                            top_k=top_k if has_tk else None,
+                            top_p=top_p if has_tp else None)
+                    else:
+                        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
                     logits, cache = decode(cparams, tok[:, None], cache, pos + i)
                     return (logits[:, 0].astype(jnp.float32), cache), tok
 
@@ -235,7 +278,8 @@ class HybridEngine(Engine):
         rng = jax.random.PRNGKey(seed) if seed is not None else self._next_rng()
         last, cache, toks = self._decode_cache[key](
             self.eval_params, state.last_logits, state.cache,
-            jnp.int32(state.pos), rng, jnp.float32(max(temperature, 1e-6)))
+            jnp.int32(state.pos), rng, jnp.float32(max(temperature, 1e-6)),
+            jnp.int32(top_k), jnp.float32(top_p))
         return GenState(
             cache=cache, last_logits=last, pos=state.pos + n_tokens,
             tokens=np.concatenate([state.tokens, np.asarray(toks)], axis=1),

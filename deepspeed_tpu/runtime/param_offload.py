@@ -1,0 +1,139 @@
+"""ZeRO-Infinity parameter offload: master params resident in host DRAM
+(or persisted on NVMe), streamed through HBM per scanned layer.
+
+Role parity with the reference's ZeRO-Infinity parameter tier
+(``runtime/zero/parameter_offload.py:117 DeepSpeedZeRoOffload`` — per-submodule
+fetch/release of host-resident partitioned params — and
+``runtime/swap_tensor/partitioned_param_swapper.py:37
+AsyncPartitionedParameterSwapper`` for the NVMe copy).
+
+TPU-native mechanism (not a port): the reference walks the module graph with
+pre/post-forward hooks, fetching each submodule's params host->GPU and
+releasing them after use. Here the decoder stack is one ``lax.scan`` over a
+stacked parameter pytree; placing that stack in the ``pinned_host`` memory
+kind and routing each scan slice through :func:`stream_slice` (installed as
+``ShardCtx.param_stream``, the same seam qwZ uses) makes XLA's host-offloader
+do the fetch: the scan's per-iteration dynamic-slice reads the host buffer and
+``jax.device_put`` moves exactly one layer's weights into HBM, prefetched by
+the latency-hiding scheduler during the previous layer's compute — the
+reference's ``__all_gather_params`` + prefetch coordinator, collapsed into the
+schedule. Under activation rematerialization the backward pass re-streams each
+layer (the reference re-fetches per backward hook), so peak HBM parameter
+bytes stay ~O(persistent params + a couple of layers), never the full model.
+
+The engine composes this with the windowed optimizer walk
+(``engine._offload_group_walk``): param groups stream host->HBM for the
+update and back, so the optimizer tail also never materializes the full
+parameter set on device.
+
+Gradients stay device-resident (fsdp-sharded fp32): :func:`stream_slice` is a
+``custom_vjp`` whose backward leaves the cotangent on device, so grads flow
+into the normal ZeRO grad layout with no host round trip.
+
+NVMe tier: the persistent master copy lives on disk via the AIO engine
+(``runtime/nvme_swap.py``); host pinned memory is the staging tier during the
+step (the reference's pinned buffer pool), with write-behind on updates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.offload import HOST_MEMORY
+
+
+def storage_shardings(param_shardings, abstract_params, threshold: int,
+                      host_ok: bool):
+    """Map the plan's param shardings to their STORAGE twins: float leaves
+    larger than ``threshold`` elements move to the pinned-host memory kind
+    (the reference's ``param_persistence_threshold`` keeps small params
+    device-resident, ``parameter_offload.py`` persistent-param set). Returns
+    ``(storage_tree, offloaded_mask_tree)``; with ``host_ok`` False (backend
+    without a working host tier) storage == device and the mask still marks
+    which leaves WOULD offload, so the streaming code path stays live."""
+
+    def decide(sh, p):
+        big = int(p.size) > threshold and jnp.issubdtype(p.dtype, jnp.floating)
+        if big and host_ok:
+            return sh.with_memory_kind(HOST_MEMORY), True
+        return sh, big
+
+    pairs = jax.tree_util.tree_map(decide, param_shardings, abstract_params)
+    store = jax.tree_util.tree_map(lambda pr: pr[0], pairs,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+    mask = jax.tree_util.tree_map(lambda pr: pr[1], pairs,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+    return store, mask
+
+
+def stream_slice(w, sharding, dtype):
+    """Host -> HBM copy + compute cast for one scan slice, with a
+    device-resident backward: the cotangent is returned as-is (fp32 cast only)
+    so gradients keep the declared device grad sharding instead of
+    transposing into a host-ward copy."""
+
+    @jax.custom_vjp
+    def f(x):
+        return jax.device_put(x, sharding).astype(dtype)
+
+    f.defvjp(lambda x: (f(x), None),
+             lambda _, g: (g.astype(jnp.float32),))
+    return f(w)
+
+
+def build_layer_stream_hook(mesh, stacked_layer_specs, layer_mask):
+    """The per-layer hook the engine installs as ``ShardCtx.param_stream``.
+
+    ``stacked_layer_specs``: the ``"layers"`` subtree of the plan's
+    param_specs (stacked leaves, leading layers dim). ``layer_mask``: the
+    congruent offloaded-mask subtree. Returns ``hook(lp, dtype)`` operating on
+    the scan body's sliced layer dict: offloaded leaves stream+cast through
+    :func:`stream_slice`, the rest cast in place (preserving the
+    ``layer_weights`` invariant that slices leave the hook compute-cast)."""
+    specs_flat, specs_def = jax.tree_util.tree_flatten(
+        stacked_layer_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    mask_flat = jax.tree_util.tree_leaves(layer_mask)
+
+    def hook(lp, dtype):
+        lp_flat, lp_def = jax.tree_util.tree_flatten(lp)
+        if lp_def != specs_def:
+            return lp  # structure mismatch: don't mis-pair leaves
+        out = []
+        for w, spec, off in zip(lp_flat, specs_flat, mask_flat):
+            if not (off and hasattr(w, "ndim")
+                    and jnp.issubdtype(w.dtype, jnp.floating)):
+                out.append(w.astype(dtype)
+                           if (hasattr(w, "dtype")
+                               and jnp.issubdtype(w.dtype, jnp.floating))
+                           else w)
+                continue
+            sl = PartitionSpec(*spec[1:]) if len(spec) > 0 else PartitionSpec()
+            out.append(stream_slice(w, NamedSharding(mesh, sl), dtype))
+        return jax.tree_util.tree_unflatten(lp_def, out)
+
+    return hook
+
+
+def cast_params_streaming(params, mask, device_shardings, compute_dtype,
+                          layers_key: str = "layers"):
+    """The engine-side replacement for ``precision.cast_to_compute`` under
+    parameter offload: the stacked ``layers`` subtree passes through UNCAST
+    (fp32, host-resident — the scan hook streams+casts slice by slice);
+    offloaded non-stacked leaves (embedding, head) stream+cast whole — they
+    are consumed outside the layer scan, so XLA schedules one early copy and
+    the buffer lives for the step (the reference's persistent-param set
+    behaves the same); everything else casts in place."""
+
+    def one(path, x, m, sh):
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if (layers_key is not None and path
+                and getattr(path[0], "key", None) == layers_key):
+            return x  # streamed per-slice inside the scan
+        if m:
+            return stream_slice(x, sh, compute_dtype)
+        return x.astype(compute_dtype)
+
+    return jax.tree_util.tree_map_with_path(one, params, mask, device_shardings)

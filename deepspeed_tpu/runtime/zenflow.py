@@ -148,10 +148,13 @@ def restore_hot_opt_state(new_state, old_state, hot_idx, block: int):
     ``hot_idx`` is a tuple of per-leaf hot block indices parallel to the
     group's param leaves.
     """
-    import optax
+    def _adam_like(x):
+        # ScaleByAdamState or any moment-carrying NamedTuple state
+        # (e.g. ZeroOneAdamState) — anything with mu/nu and _replace
+        return hasattr(x, "mu") and hasattr(x, "nu") and hasattr(x, "_replace")
 
     def fix(new, old):
-        if not isinstance(new, optax.ScaleByAdamState):
+        if not _adam_like(new):
             return new
 
         def rest(tree_new, tree_old):
@@ -164,8 +167,7 @@ def restore_hot_opt_state(new_state, old_state, hot_idx, block: int):
         return new._replace(mu=rest(new.mu, old.mu), nu=rest(new.nu, old.nu))
 
     return jax.tree_util.tree_map(
-        fix, new_state, old_state,
-        is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState))
+        fix, new_state, old_state, is_leaf=_adam_like)
 
 
 def reset_moments(hot: dict, new_idx: list) -> dict:

@@ -1,0 +1,182 @@
+"""Sampling surface: temperature / top-k / top-p / repetition penalty
+(reference ``inference/engine.py:586 _generate`` HF sampling kwargs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.sampling import (
+    apply_repetition_penalty,
+    sample_tokens,
+    update_seen,
+)
+
+
+def _logits(rows):
+    return jnp.asarray(np.array(rows, np.float32))
+
+
+class TestTopK:
+    def test_samples_only_from_top_k(self):
+        logits = jnp.asarray(np.random.default_rng(0).normal(size=(4, 50)),
+                             jnp.float32)
+        order = np.argsort(np.asarray(logits), axis=-1)[:, ::-1]
+        allowed = {(r, int(c)) for r in range(4) for c in order[r, :5]}
+        for s in range(30):
+            toks, _ = sample_tokens(logits, jax.random.PRNGKey(s),
+                                    temperature=1.0, top_k=5)
+            for r, t in enumerate(np.asarray(toks)):
+                assert (r, int(t)) in allowed
+
+    def test_top_k_one_is_greedy(self):
+        logits = jnp.asarray(np.random.default_rng(1).normal(size=(3, 20)),
+                             jnp.float32)
+        toks, lp = sample_tokens(logits, jax.random.PRNGKey(0),
+                                 temperature=1.0, top_k=1)
+        np.testing.assert_array_equal(
+            np.asarray(toks), np.argmax(np.asarray(logits), axis=-1))
+        # single-choice distribution: logprob of the chosen token is ~0
+        assert np.all(np.asarray(lp) > -1e-3)
+
+    def test_per_row_k(self):
+        logits = _logits([[0.0, 1.0, 2.0, 3.0]] * 2)
+        for s in range(20):
+            toks, _ = sample_tokens(logits, jax.random.PRNGKey(s),
+                                    temperature=1.0,
+                                    top_k=np.asarray([1, 2], np.int32))
+            assert int(toks[0]) == 3
+            assert int(toks[1]) in (2, 3)
+
+
+class TestTopP:
+    def test_mass_bound(self):
+        """The surviving set is the smallest descending-probability prefix
+        with cumulative mass >= top_p."""
+        p = np.array([[0.5, 0.3, 0.15, 0.05]], np.float32)
+        logits = jnp.asarray(np.log(p))
+        # top_p=0.6: {0.5} reaches only 0.5 < 0.6, so {0.5, 0.3} survives
+        seen = set()
+        for s in range(200):
+            toks, _ = sample_tokens(logits, jax.random.PRNGKey(s),
+                                    temperature=1.0, top_p=0.6)
+            seen.add(int(toks[0]))
+        assert seen == {0, 1}
+
+    def test_top_of_distribution_always_survives(self):
+        p = np.array([[0.9, 0.06, 0.04]], np.float32)
+        logits = jnp.asarray(np.log(p))
+        for s in range(50):
+            toks, _ = sample_tokens(logits, jax.random.PRNGKey(s),
+                                    temperature=1.0, top_p=0.01)
+            assert int(toks[0]) == 0  # tiny top_p -> argmax only
+
+    def test_disabled_at_one(self):
+        logits = jnp.asarray(
+            np.random.default_rng(2).normal(size=(2, 30)), jnp.float32)
+        a, _ = sample_tokens(logits, jax.random.PRNGKey(7), 1.0, top_p=1.0)
+        b, _ = sample_tokens(logits, jax.random.PRNGKey(7), 1.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestRepetitionPenalty:
+    def test_monotone_suppression(self):
+        """Raising the penalty strictly lowers a seen token's (positive and
+        negative) logit relative to unseen ones — the CTRL rule."""
+        logits = _logits([[2.0, 1.9, -0.5]])
+        seen = jnp.asarray([[True, False, True]])
+        prev = None
+        for pen in (1.0, 1.2, 1.5, 2.0):
+            out = np.asarray(apply_repetition_penalty(
+                logits, seen, jnp.asarray([pen], jnp.float32)))[0]
+            assert out[1] == pytest.approx(1.9)  # unseen untouched
+            if prev is not None:
+                assert out[0] < prev[0]
+                assert out[2] < prev[2]
+            prev = out
+
+    def test_greedy_flip(self):
+        """A large enough penalty flips a greedy pick off a seen token."""
+        logits = _logits([[2.0, 1.9]])
+        seen = jnp.asarray([[True, False]])
+        toks, _ = sample_tokens(logits, jax.random.PRNGKey(0), 0.0,
+                                repetition_penalty=2.0, seen_mask=seen)
+        assert int(toks[0]) == 1
+
+    def test_update_seen(self):
+        seen = jnp.zeros((2, 5), jnp.bool_)
+        seen = update_seen(seen, jnp.asarray([3, 0]))
+        got = np.asarray(seen)
+        assert got[0, 3] and got[1, 0] and got.sum() == 2
+
+
+class TestGreedySampledMix:
+    def test_per_row_temperature(self):
+        logits = jnp.asarray(
+            np.random.default_rng(3).normal(size=(2, 40)), jnp.float32)
+        toks, lp = sample_tokens(
+            logits, jax.random.PRNGKey(5),
+            temperature=np.asarray([0.0, 1.0], np.float32))
+        assert int(toks[0]) == int(np.argmax(np.asarray(logits)[0]))
+        assert np.all(np.asarray(lp) <= 0.0)
+
+
+class TestEngineIntegration:
+    def test_dense_generate_sampling(self):
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.models import llama
+
+        cfg = llama.LlamaConfig(
+            vocab_size=97, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=64)
+        eng = InferenceEngine(lambda ctx: llama.build(cfg, ctx=ctx),
+                              dtype=jnp.float32, seed=0)
+        ids = np.random.default_rng(4).integers(0, 97, (2, 8), dtype=np.int32)
+        greedy = eng.generate(ids, max_new_tokens=6)
+        topk1 = eng.generate(ids, max_new_tokens=6, temperature=0.7, top_k=1)
+        np.testing.assert_array_equal(greedy, topk1)  # top_k=1 == greedy
+        sampled = eng.generate(ids, max_new_tokens=6, temperature=1.2,
+                               top_p=0.95, seed=3)
+        assert sampled.shape == greedy.shape
+        assert np.all((sampled >= 0) & (sampled < 97))
+        # no-repeat under a harsh penalty: a generated token never repeats
+        pen = eng.generate(ids[:1], max_new_tokens=6, repetition_penalty=1e9)
+        new = list(pen[0, 8:])
+        assert len(set(new)) == len(new)
+        assert not set(new) & set(ids[0])  # prompt tokens penalized too
+
+    def test_hybrid_rollout_logprobs_match_behavior_policy(self):
+        """top_k=1 makes the final distribution a point mass -> recorded
+        logprobs ~0; this only holds if logprobs come from the SAME filtered
+        distribution the token was drawn from (the round-4 advisor fix,
+        generalized)."""
+        from deepspeed_tpu.comm.comm import init_distributed
+        from deepspeed_tpu.comm.topology import reset_topology
+        from deepspeed_tpu.config.config import load_config
+        from deepspeed_tpu.models import llama
+        from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
+
+        reset_topology()
+        cfg = load_config({
+            "train_micro_batch_size_per_device": 2,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 0,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "mesh": {"data": -1},
+            "seed": 3,
+        })
+        topo = init_distributed(cfg.mesh)
+        cfg.resolve_batch_sizes(topo.dp_world_size)
+        engine = HybridEngine(
+            lambda ctx: llama.build(llama.LlamaConfig.tiny(97), ctx=ctx),
+            cfg, topo, inference_dtype=jnp.float32)
+        prompts = [np.arange(5, dtype=np.int32), np.arange(7, dtype=np.int32)]
+        outs = engine.generate_rollouts(
+            prompts, max_new_tokens=4, temperature=0.8, top_k=1, seed=1)
+        for o in outs:
+            assert np.all(np.asarray(o["logprobs"]) > -1e-3)
+        outs2 = engine.generate_rollouts(
+            prompts, max_new_tokens=4, temperature=0.8, top_p=0.9, seed=1)
+        for o in outs2:
+            lps = np.asarray(o["logprobs"])
+            assert np.all(lps <= 0.0) and np.all(lps > -20.0)

@@ -81,7 +81,7 @@ def test_group_apply_overflow_guard(tmp_path):
     """finite=False must write back unchanged params + state (the on-device
     equivalent of the reference's speculative-step rollback)."""
     engine = _engine("nvme", tmp_path, super_offload=True)
-    apply_g = engine._build_group_apply_fn()
+    apply_g = engine._group_apply(0)
     pg = (jnp.ones((8,), jnp.float32),)
     state = engine.optimizer.init(pg)
     gg = (jnp.full((8,), jnp.inf, jnp.float32),)
