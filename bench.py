@@ -21,6 +21,16 @@ import subprocess
 import sys
 import time
 
+# Persistent XLA compilation cache: verified effective through the axon
+# remote-compile transport (second process: 1.46 s compile -> 0.02 s). Trial
+# subprocesses inherit it via the environment, so the serve engine's
+# program-zoo warmup and repeat bench invocations stop paying multi-second
+# compiles (which were dominating staggered-serve latency).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
 # (bf16 peak FLOPs/s, HBM bytes) per chip by TPU generation (public spec sheets)
 CHIP_TABLE = {
     "v5 lite": (197e12, 16e9), "v5e": (197e12, 16e9),
@@ -232,7 +242,7 @@ def serve_trial_main():
         # serving cost here; the dense baseline amortizes it over one
         # whole-batch decode scan per batch
         max_seqs, budget, block, tile, ahead = 32, 1024, 32, 128, 48
-        fused, depth = 8, 2
+        fused, depth = 16, 3
     else:
         model_cfg = llama.LlamaConfig(
             vocab_size=512, hidden_size=256, intermediate_size=688,
@@ -274,6 +284,12 @@ def serve_trial_main():
         model=lambda ctx: llama.build(model_cfg, ctx=ctx),
         ragged_config=rcfg, seed=0,
     )
+    # precompile the fused program zoo (fills the persistent cache; without
+    # it, shape combos first hit mid-serve cost 4-5 s stalls each)
+    t0 = time.perf_counter()
+    nwarm = ragged.warmup()
+    print(f"# ragged warmup: {nwarm} programs in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     def run_ragged():
         for i, p in enumerate(prompts):
@@ -546,7 +562,235 @@ def _enable_jit_cache():
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def smoke_main():
+    """On-accelerator smoke suite (<5 min warm): the kernels and engine
+    paths the CPU test mesh can only interpret-check run HERE, where Pallas
+    actually lowers (round-4 item 9). Prints one JSON line with per-check
+    status; exit code 1 on any failure."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    checks: dict = {}
+    perf: dict = {}
+    t_all = time.perf_counter()
+
+    def run(name):
+        def deco(fn):
+            t0 = time.perf_counter()
+            try:
+                fn()
+                checks[name] = {"ok": True,
+                                "s": round(time.perf_counter() - t0, 2)}
+            except Exception as e:  # noqa: BLE001 - report, don't crash suite
+                checks[name] = {"ok": False, "error": str(e)[:300],
+                                "s": round(time.perf_counter() - t0, 2)}
+            return fn
+        return deco
+
+    @run("flash_attention_fwd_bwd")
+    def _flash():
+        from deepspeed_tpu.ops.attention import attention, xla_attention
+
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(2, 256, 8, 64)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(2, 256, 4, 64)), jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(2, 256, 4, 64)), jnp.bfloat16)
+
+        def loss_fl(q, k, v):
+            return attention(q, k, v, causal=True, impl="pallas").astype(
+                jnp.float32).sum()
+
+        def loss_ref(q, k, v):
+            return xla_attention(q, k, v, causal=True).astype(
+                jnp.float32).sum()
+
+        o = jax.jit(lambda *a: attention(*a, causal=True, impl="pallas"))(
+            q, k, v)
+        o_ref = jax.jit(lambda *a: xla_attention(*a, causal=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(o, np.float32),
+                                   np.asarray(o_ref, np.float32),
+                                   atol=3e-2, rtol=3e-2)
+        g = jax.jit(jax.grad(loss_fl, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       atol=6e-2, rtol=6e-2)
+
+    @run("paged_decode_kernel_vs_gather")
+    def _paged():
+        from deepspeed_tpu.ops.attention import paged_attention
+
+        rng = np.random.default_rng(1)
+        t, mb, bs, hq, hkv, d = 16, 8, 32, 16, 8, 64
+        nb = t * mb + 1
+        q = jnp.asarray(rng.normal(size=(t, hq, d)), jnp.bfloat16)
+        kp = jnp.asarray(rng.normal(size=(nb, bs, hkv, d)), jnp.bfloat16)
+        vp = jnp.asarray(rng.normal(size=(nb, bs, hkv, d)), jnp.bfloat16)
+        slots = jnp.arange(t, dtype=jnp.int32)
+        positions = jnp.asarray(rng.integers(1, mb * bs, (t,)), jnp.int32)
+        # read-only parity check: aliased blocks across rows are fine
+        bt = jnp.asarray(rng.integers(1, nb, (t + 1, mb)), jnp.int32)
+        a = jax.jit(lambda *x: paged_attention(*x, impl="pallas"))(
+            q, kp, vp, slots, positions, bt)
+        b = jax.jit(lambda *x: paged_attention(*x, impl="xla"))(
+            q, kp, vp, slots, positions, bt)
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=3e-2, rtol=3e-2)
+
+    @run("zero3_train_step")
+    def _z3():
+        import deepspeed_tpu
+        from deepspeed_tpu.comm.topology import reset_topology
+        from deepspeed_tpu.models import llama
+
+        reset_topology()
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=lambda ctx: llama.build(llama.LlamaConfig.tiny(512),
+                                          ctx=ctx),
+            config={"train_micro_batch_size_per_device": 4,
+                    "gradient_accumulation_steps": 1, "steps_per_print": 0,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 3}, "mesh": {"data": -1},
+                    "seed": 3}, seed=3)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 512, (4, 64), dtype=np.int32)}
+        l0 = float(eng.train_batch(batch))
+        l1 = float(eng.train_batch(batch))
+        assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0, (l0, l1)
+
+    @run("zero_infinity_memory")
+    def _inf():
+        import deepspeed_tpu
+        from deepspeed_tpu.comm.topology import reset_topology
+        from deepspeed_tpu.models import llama
+
+        reset_topology()
+        mcfg = llama.LlamaConfig(
+            vocab_size=2048, hidden_size=512, intermediate_size=1536,
+            num_layers=8, num_heads=8, num_kv_heads=4, max_seq_len=512)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=lambda ctx: llama.build(mcfg, ctx=ctx),
+            config={"train_micro_batch_size_per_device": 2,
+                    "gradient_accumulation_steps": 1, "steps_per_print": 0,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "stage": 3, "sub_group_size": 4_000_000,
+                        "offload_param": {"device": "cpu"},
+                        "offload_optimizer": {"device": "cpu"}},
+                    "activation_checkpointing": {"enabled": True},
+                    "mesh": {"data": 1, "fsdp": 1}, "seed": 3}, seed=3)
+        param_bytes = eng.model_spec.num_params * 4
+        kinds = {x.sharding.memory_kind
+                 for x in jax.tree_util.tree_leaves(eng.params)}
+        assert kinds == {"pinned_host"}, kinds
+        # the round-4 'done' criterion: peak HBM param bytes < total param
+        # bytes — the grads program's device footprint must exclude the
+        # host-resident masters (they are host args, streamed per layer)
+        if eng._grads_jit is None:
+            eng._grads_jit = eng._build_grads_fn()
+        rng = np.random.default_rng(0)
+        db = eng._put_gas_batch(
+            {"input_ids": rng.integers(0, 2048, (2, 256), dtype=np.int32)})
+        ma = eng._grads_jit.lower(
+            eng.params, eng.scale_state, jnp.int32(0), eng._train_rng, db
+        ).compile().memory_analysis()
+        assert ma.argument_size_in_bytes < param_bytes / 4, \
+            ma.argument_size_in_bytes
+        assert ma.host_argument_size_in_bytes >= param_bytes, \
+            ma.host_argument_size_in_bytes
+        loss = float(eng.train_batch(
+            {"input_ids": rng.integers(0, 2048, (2, 256), dtype=np.int32)}))
+        assert np.isfinite(loss)
+
+    @run("evoformer_sparse_perf")
+    def _science():
+        # perf evidence for the science kernels (round-4 weak #7): timed on
+        # the real accelerator vs dense attention at the same shape; numbers
+        # land in the smoke JSON
+        from deepspeed_tpu.ops.evoformer import evoformer_attention
+        from deepspeed_tpu.ops.sparse_attention import (
+            blocksparse_attention,
+            make_local_layout,
+        )
+        from deepspeed_tpu.ops.attention import xla_attention
+
+        rng = np.random.default_rng(3)
+
+        def timeit(f, *a, iters=10):
+            o = f(*a)
+            jax.block_until_ready(o)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                o = f(*a)
+            jax.block_until_ready(o)
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        # evoformer: [B, S, R, H, D] MSA-row attention with pair biases
+        q = jnp.asarray(rng.normal(size=(1, 8, 256, 4, 32)), jnp.bfloat16)
+        b1 = jnp.asarray(rng.normal(size=(1, 8, 1, 1, 256)), jnp.float32)
+        b2 = jnp.asarray(rng.normal(size=(1, 1, 4, 256, 256)), jnp.float32)
+        evo = jax.jit(lambda q, b1, b2: evoformer_attention(
+            q, q, q, (b1, b2), chunk_size=64))
+        perf["evoformer_ms"] = round(timeit(evo, q, b1, b2), 2)
+
+        # blocksparse local attention vs dense at seq 2048
+        s, blk = 2048, 64
+        layout = make_local_layout(s // blk, window=4)
+        qs = jnp.asarray(rng.normal(size=(2, s, 8, 64)), jnp.bfloat16)
+        sp = jax.jit(lambda q: blocksparse_attention(
+            q, q, q, layout, blk, causal=True))
+        dn = jax.jit(lambda q: xla_attention(q, q, q, causal=True))
+        perf["sparse_local_ms"] = round(timeit(sp, qs), 2)
+        perf["dense_same_shape_ms"] = round(timeit(dn, qs), 2)
+
+    @run("ragged_fused_serve")
+    def _serve():
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.inference.ragged import (
+            RaggedConfig,
+            RaggedInferenceEngine,
+        )
+        from deepspeed_tpu.models import llama
+
+        mcfg = llama.LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=688,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256)
+        rng = np.random.default_rng(2)
+        prompts = {i: rng.integers(0, 512, (int(L),), dtype=np.int32)
+                   for i, L in enumerate([9, 17, 33])}
+        # fp32: greedy argmax parity between the dense-cache and paged-pool
+        # attention orders must not hinge on bf16 ties
+        dense = InferenceEngine(lambda ctx: llama.build(mcfg, ctx=ctx),
+                                dtype=jnp.float32, seed=0)
+        want = {u: list(np.asarray(
+            dense.generate(p[None], max_new_tokens=8))[0, len(p):])
+            for u, p in prompts.items()}
+        eng = RaggedInferenceEngine(
+            model=lambda ctx: llama.build(mcfg, ctx=ctx), seed=0,
+            dtype=jnp.float32,
+            ragged_config=RaggedConfig(
+                max_tokens_per_step=64, max_seqs=4, block_size=16,
+                num_blocks=33, max_blocks_per_seq=8, fused_chunk=4,
+                pipeline_depth=2, prefill_tile=16))
+        for u, p in prompts.items():
+            eng.put(u, p, max_new_tokens=8)
+        got = eng.generate_all()
+        assert got == want, "fused serve != dense greedy"
+
+    ok = all(c["ok"] for c in checks.values())
+    print(json.dumps({"smoke_ok": ok, "checks": checks, "perf": perf,
+                      "total_s": round(time.perf_counter() - t_all, 1),
+                      "backend": __import__("jax").default_backend()}))
+    return 0 if ok else 1
+
+
 def main():
+    if "--smoke" in sys.argv or os.environ.get("BENCH_SMOKE"):
+        _enable_jit_cache()
+        return smoke_main()
     if os.environ.get("BENCH_SERVE"):
         _enable_jit_cache()
         return serve_trial_main()

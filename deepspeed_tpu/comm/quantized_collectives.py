@@ -25,7 +25,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.ops.quantizer import dequantize, quantize
+from deepspeed_tpu.ops.quantizer import (
+    dequantize,
+    dequantize_signs,
+    quantize,
+    quantize_signs,
+)
+
+SUPPORTED_WIRE_BITS = (1, 4, 8)
 
 
 def _pad_to(flat: jnp.ndarray, multiple: int) -> jnp.ndarray:
@@ -33,22 +40,58 @@ def _pad_to(flat: jnp.ndarray, multiple: int) -> jnp.ndarray:
     return jnp.pad(flat, (0, pad)) if pad else flat
 
 
+def _check_bits(bits: int) -> None:
+    if bits not in SUPPORTED_WIRE_BITS:
+        raise NotImplementedError(
+            f"quantized collectives support bits in {SUPPORTED_WIRE_BITS}, "
+            f"got {bits}")
+
+
+def _wire_encode(rows: jnp.ndarray, bits: int, block: int):
+    """[n, chunk] fp32 -> (wire payload [n, B], scales [n, S], dequantized
+    round-trip [n, chunk]). The payload rows ARE what crosses the wire:
+    uint8 sign-bytes (1-bit, B = chunk/8), nibble-packed int8 (4-bit,
+    B = chunk/2) or int8 (8-bit, B = chunk)."""
+    n, chunk = rows.shape
+    if bits == 1:
+        packed, scales = quantize_signs(rows, block)
+        deq = dequantize_signs(packed, scales, rows.size, block).reshape(
+            n, chunk)
+        return packed.reshape(n, -1), scales.reshape(n, -1), deq
+    qt = quantize(rows, bits=bits, block=block)
+    deq = dequantize(qt).reshape(n, chunk)
+    return qt.values.reshape(n, -1), qt.scales.reshape(n, -1), deq
+
+
+def _wire_decode(vals: jnp.ndarray, scales: jnp.ndarray, bits: int,
+                 block: int, n: int, chunk: int) -> jnp.ndarray:
+    """Inverse of :func:`_wire_encode` -> fp32 [n, chunk]."""
+    if bits == 1:
+        return dequantize_signs(vals.reshape(-1), scales.reshape(-1),
+                                n * chunk, block).reshape(n, chunk)
+    from deepspeed_tpu.ops.quantizer import QuantizedTensor
+
+    qt = QuantizedTensor(values=vals.reshape(-1, block if bits == 8
+                                             else block // 2),
+                         scales=scales.reshape(-1), shape=(n, chunk),
+                         bits=bits, block=block)
+    return dequantize(qt).reshape(n, chunk)
+
+
 def quantized_all_reduce(x, axis_name: str, error=None, bits: int = 8,
                          block: int = 64):
-    """Mean-allreduce of rank-local ``x`` over ``axis_name`` with int8 wire
-    payloads (call inside ``shard_map``).
+    """Mean-allreduce of rank-local ``x`` over ``axis_name`` with a low-bit
+    wire payload — 1-bit sign+scale (the reference compressed/1-bit
+    allreduce, ``runtime/comm/nccl.py:17`` + ``csrc/quantization/
+    quant_reduce.cu``), nibble-packed int4, or int8 (call inside
+    ``shard_map``).
 
     Returns ``(mean, new_error)``. ``error`` is this rank's residual from the
     previous call (same shape as ``x``); the first-stage quantization error
     stays local, and the owner-segment second-stage error is re-injected
-    scaled by the axis size (LOCO) so the *mean* converges.
+    scaled by the axis size so the *mean* converges.
     """
-    if bits != 8:
-        raise NotImplementedError(
-            "quantized_all_reduce supports bits=8 only (int4 payloads are "
-            "nibble-packed by the quantizer, incompatible with this reducer's "
-            "inline dequantization layout)"
-        )
+    _check_bits(bits)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     shape = x.shape
@@ -60,26 +103,21 @@ def quantized_all_reduce(x, axis_name: str, error=None, bits: int = 8,
     chunk = flat.size // n
     chunks = flat.reshape(n, chunk)
 
-    # stage 1: quantize per chunk; all-to-all the int8 payload + scales
-    qt = quantize(chunks, bits=bits, block=block)
-    e1 = flat - dequantize(qt).reshape(-1)
-    v = qt.values.reshape(n, -1)                      # int8 [n, chunk_bytes]
-    s = qt.scales.reshape(n, -1)                      # f32  [n, chunk//block]
+    # stage 1: quantize per chunk; all-to-all the packed payload + scales
+    v, s, deq = _wire_encode(chunks, bits, block)
+    e1 = (chunks - deq).reshape(-1)
     v_recv = lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0)
     s_recv = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
 
     # local dequant + reduce of my segment
-    blocks = v_recv.reshape(-1, block).astype(jnp.float32)
-    scales = s_recv.reshape(-1)
-    seg = (blocks * scales[:, None]).reshape(n, chunk).sum(axis=0) / n
+    seg = _wire_decode(v_recv, s_recv, bits, block, n, chunk).sum(axis=0) / n
 
-    # stage 2: requantize my reduced segment; all-gather int8
-    qt2 = quantize(seg, bits=bits, block=block)
-    e2 = seg - dequantize(qt2).reshape(-1)[:chunk]
-    v2 = lax.all_gather(qt2.values.reshape(-1), axis_name)   # int8 [n, ...]
-    s2 = lax.all_gather(qt2.scales, axis_name)
-    out_blocks = v2.reshape(-1, block).astype(jnp.float32)
-    out = (out_blocks * s2.reshape(-1)[:, None]).reshape(-1)[: flat.size]
+    # stage 2: requantize my reduced segment; all-gather the packed payload
+    v2, s2, deq2 = _wire_encode(seg[None], bits, block)
+    e2 = seg - deq2[0]
+    v2g = lax.all_gather(v2.reshape(-1), axis_name)
+    s2g = lax.all_gather(s2.reshape(-1), axis_name)
+    out = _wire_decode(v2g, s2g, bits, block, n, chunk).reshape(-1)
     mean = out[: xf.size].reshape(shape)
 
     # error feedback: my own stage-1 residuals (for every destination chunk)
@@ -104,8 +142,7 @@ def loco_quantized_all_reduce(x, axis_name: str, error_local=None,
     Returns ``(mean, new_error_local, new_error_server)``. ``error_server``
     has the owner-segment shape: ``ceil(x.size / n)`` padded elements.
     """
-    if bits != 8:
-        raise NotImplementedError("loco_quantized_all_reduce supports bits=8 only")
+    _check_bits(bits)
     n = lax.axis_size(axis_name)
     shape = x.shape
     xf = x.astype(jnp.float32)
@@ -116,29 +153,24 @@ def loco_quantized_all_reduce(x, axis_name: str, error_local=None,
     chunk = flat.size // n
     chunks = flat.reshape(n, chunk)
 
-    # stage 1: quantize per destination chunk; all-to-all int8 + scales;
+    # stage 1: quantize per destination chunk; all-to-all payload + scales;
     # sender keeps its own residual (for every destination)
-    qt = quantize(chunks, bits=bits, block=block)
-    e1 = flat - dequantize(qt).reshape(-1)
-    v = qt.values.reshape(n, -1)
-    s = qt.scales.reshape(n, -1)
+    v, s, deq = _wire_encode(chunks, bits, block)
+    e1 = (chunks - deq).reshape(-1)
     v_recv = lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0)
     s_recv = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
 
-    blocks = v_recv.reshape(-1, block).astype(jnp.float32)
-    scales = s_recv.reshape(-1)
-    seg = (blocks * scales[:, None]).reshape(n, chunk).sum(axis=0) / n
+    seg = _wire_decode(v_recv, s_recv, bits, block, n, chunk).sum(axis=0) / n
     # owner-side compensation: inject the PREVIOUS window's stage-2 residual
     if error_server is not None:
         seg = seg + error_server.astype(jnp.float32)
 
     # stage 2: requantize the compensated segment; residual stays owner-side
-    qt2 = quantize(seg, bits=bits, block=block)
-    new_es = seg - dequantize(qt2).reshape(-1)[:chunk]
-    v2 = lax.all_gather(qt2.values.reshape(-1), axis_name)
-    s2 = lax.all_gather(qt2.scales, axis_name)
-    out_blocks = v2.reshape(-1, block).astype(jnp.float32)
-    out = (out_blocks * s2.reshape(-1)[:, None]).reshape(-1)[: flat.size]
+    v2, s2, deq2 = _wire_encode(seg[None], bits, block)
+    new_es = seg - deq2[0]
+    v2g = lax.all_gather(v2.reshape(-1), axis_name)
+    s2g = lax.all_gather(s2.reshape(-1), axis_name)
+    out = _wire_decode(v2g, s2g, bits, block, n, chunk).reshape(-1)
     mean = out[: xf.size].reshape(shape)
 
     new_el = e1[: xf.size].reshape(shape)

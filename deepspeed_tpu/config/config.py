@@ -191,9 +191,16 @@ class ZeroConfig(ConfigBase):
     # offload windowing: elements per optimizer sub-group (reference stage3
     # sub_group_size); one group's state is in HBM at a time
     sub_group_size: int = 100_000_000
-    # ZeRO++ qgZ: int8-quantized gradient reduction with error feedback
+    # ZeRO++ qgZ: quantized gradient reduction with error feedback
     # (comm/quantized_collectives.py; requires a pure data-parallel mesh)
     quantized_gradients: bool = False
+    # wire width for the quantized reduction: 8 (qgZ int8), 4 (nibble-packed)
+    # or 1 (sign+scale — the 1-bit Adam/LAMB compressed wire, reference
+    # runtime/comm/nccl.py compressed_allreduce). With a 1-bit-family
+    # optimizer the engine runs a DENSE wire during the optimizer's warmup
+    # (freeze_step) and switches to this width after, matching the reference
+    # two-phase protocol.
+    quantized_gradients_bits: int = 8
     # ZeRO++ qwZ: int8 blockwise-quantized weight all-gather on the stage-3
     # path (parallel/qwz.py; reference partition_parameters.py:1446 quantized
     # all_gather_coalesced). Halves the dominant stage-3 collective.
@@ -201,12 +208,23 @@ class ZeroConfig(ConfigBase):
     qwz_block: int = 128
     # ZenFlow split update over the offloaded tier (runtime/zenflow.py)
     zenflow: ZenFlowConfig = field(default_factory=ZenFlowConfig)
-    # MiCS / ZeRO++ hpZ: optimizer+gradient state shards over the FULL world
+    # ZeRO++ hpZ: optimizer+gradient state shards over the FULL world
     # (data x fsdp) while live stage-3 params shard over fsdp only, so param
-    # gathers ride the fast intra-group axis (reference runtime/zero/mics.py
-    # + partition_parameters.py:1806 secondary partition). Map the reference
+    # gathers ride the fast intra-group axis (reference
+    # partition_parameters.py:1806 secondary partition). Map the reference
     # layout onto the mesh: fsdp = intra-group (ICI), data = across groups.
     hierarchical_partitioning: bool = False
+    # MiCS (reference runtime/zero/mics.py:63 MiCS_Init / :361
+    # MiCS_Optimizer): bound the ZeRO-3 shard degree to a GROUP of
+    # ``mics_shard_size`` devices (< world); params/grads/optimizer state
+    # partition within the group and replicate across world/k groups, with
+    # cross-group gradient allreduce keeping replicas in sync. On TPU this
+    # IS a mesh factorization — fsdp=k (intra-group, rides ICI), data=world/k
+    # (replica groups; grads psum there) — which ``initialize`` derives from
+    # this knob; the reference's hierarchical cross-group allgather
+    # (mics_hierarchical_params_gather) is what XLA's topology-aware
+    # collective lowering does by construction. 0 = off.
+    mics_shard_size: int = 0
 
     def _validate(self, path: str = "") -> None:
         if self.stage not in (0, 1, 2, 3):
@@ -217,6 +235,23 @@ class ZeroConfig(ConfigBase):
                 f"all-gather; it requires stage 3 (got stage {self.stage})")
         if self.qwz_block < 1:
             raise ConfigError(f"{path}qwz_block: must be >= 1")
+        if self.quantized_gradients_bits not in (1, 4, 8):
+            raise ConfigError(
+                f"{path}quantized_gradients_bits: must be 1, 4 or 8, got "
+                f"{self.quantized_gradients_bits}")
+        if self.mics_shard_size < 0:
+            raise ConfigError(
+                f"{path}mics_shard_size: must be >= 0, got "
+                f"{self.mics_shard_size}")
+        if self.mics_shard_size > 0 and self.stage != 3:
+            raise ConfigError(
+                f"{path}mics_shard_size: MiCS bounds the stage-3 shard "
+                f"degree; it requires stage 3 (got stage {self.stage})")
+        if self.mics_shard_size > 0 and self.hierarchical_partitioning:
+            raise ConfigError(
+                f"{path}mics_shard_size: MiCS (opt state within the group) "
+                "and hierarchical_partitioning (hpZ, opt state over the full "
+                "world) prescribe conflicting master layouts; pick one")
 
     @classmethod
     def from_dict(cls, data, path: str = ""):
@@ -238,6 +273,10 @@ class ZeroConfig(ConfigBase):
                     "secondary-partition group is the mesh's fsdp axis)."
                 )
                 data["hierarchical_partitioning"] = True
+        # Reference MiCS gather knob: hierarchical cross-group allgather is
+        # what XLA's topology-aware collective lowering already does; accept
+        # the key so ported configs load, nothing to configure.
+        data.pop("mics_hierarchical_params_gather", None)
         # Reference spellings for qwZ/qgZ (`zero_quantized_weights`,
         # `zero_quantized_gradients`).
         for ref_key, key in (("zero_quantized_weights", "quantized_weights"),
@@ -459,9 +498,35 @@ class EigenvalueConfig(ConfigBase):
 
 
 @dataclass
+class RandomLTDConfig(ConfigBase):
+    """Random layerwise token dropping (reference ``runtime/data_pipeline/
+    data_routing/basic_layer.py`` + ``csrc/random_ltd``): each decoder layer
+    processes a random subset of tokens, ramping from ``start_keep_ratio``
+    of the sequence back to 1.0 over ``total_steps`` (the reference's
+    seq-length schedule). Kept counts are bucketed to ``bucket`` tokens —
+    each bucket value is one compiled program."""
+
+    enabled: bool = False
+    start_keep_ratio: float = 0.5
+    total_steps: int = 1000
+    bucket: int = 64
+
+    def _validate(self, path: str = "") -> None:
+        if not 0.0 < self.start_keep_ratio <= 1.0:
+            raise ConfigError(
+                f"{path}start_keep_ratio: must be in (0, 1], got "
+                f"{self.start_keep_ratio}")
+        if self.total_steps < 1:
+            raise ConfigError(f"{path}total_steps: must be >= 1")
+        if self.bucket < 1:
+            raise ConfigError(f"{path}bucket: must be >= 1")
+
+
+@dataclass
 class DataEfficiencyConfig(ConfigBase):
     enabled: bool = False
     curriculum_learning: dict = field(default_factory=dict)
+    random_ltd: RandomLTDConfig = field(default_factory=RandomLTDConfig)
 
 
 @dataclass

@@ -429,11 +429,12 @@ class RaggedInferenceEngine:
             self._chunk_jit = self._build_decode_chunk()
         rng = jax.random.fold_in(self._dispatch_rng, self._chunk_counter)
         self._chunk_counter += 1
+        max_pos = max(s.pos + k - 1 for s in seqs)
         out, self.cache = self._chunk_jit(
             k, sampled, bool(topk.any()), bool((topp < 1.0).any()),
             self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(positions),
-            jnp.asarray(self.block_tables), rng,
+            jnp.asarray(self._table_view(max_pos)), rng,
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
         )
         self.dispatch_count += 1
@@ -452,6 +453,28 @@ class RaggedInferenceEngine:
             if s.finished:
                 self._release(s)
         return emit
+
+    def _table_view(self, max_pos: int):
+        """Slice the block table to the bucketed block count covering
+        ``max_pos`` (the highest position any token in this dispatch will
+        touch). The Pallas kernels grid their KV loop over the TABLE WIDTH,
+        so a full-width table makes every token pay ``max_blocks_per_seq``
+        grid steps regardless of its context (the round-4 bandwidth finding);
+        slicing host-side bounds the grid by the batch's ACTUAL context.
+
+        Short tables pass through whole: every distinct width is a fresh
+        program shape, and on a remote-compile transport a handful of extra
+        compiles costs far more than the grid steps it saves (measured: the
+        full-width 18-block table beats a 2/4/8/16-bucket ladder end to
+        end). Power-of-4 buckets keep the long-context compile count tiny."""
+        mb = self.cfg.max_blocks_per_seq
+        if mb <= 64:
+            return self.block_tables
+        need = max_pos // self.cfg.block_size + 1
+        b = 16
+        while b < need:
+            b *= 4
+        return self.block_tables[:, :min(b, mb)]
 
     def _plan_prefill_tiles(self, nd: int, budget: int):
         """Pick tile-aligned prompt chunks for this step (shared by the
@@ -578,6 +601,95 @@ class RaggedInferenceEngine:
         self._fused_jits[key] = fn
         return fn
 
+    def warmup(self, sampled: bool = False, has_tk: bool = False,
+               has_tp: bool = False) -> int:
+        """Precompile the fused-chunk program zoo via ``lower().compile()``
+        (no execution, no engine state touched). On a remote-compile
+        transport every NOVEL (k, nd, nt) combo otherwise costs seconds of
+        compilation in the middle of serving — measured as 4-5 s stalls that
+        dominated staggered-arrival latency. Returns the number of programs
+        compiled. Greedy combos by default; call again with ``sampled``/
+        filter flags for sampling workloads."""
+        if self.cfg.fused_chunk < 2:
+            return 0
+        cfg = self.cfg
+        ct = cfg.prefill_tile if self._use_tiles else 0
+        k = cfg.fused_chunk
+        nd_full = next(b for b in self._dec_buckets if b >= cfg.max_seqs)
+        combos: set = set()
+        if ct:
+            cap0 = max(1, (cfg.max_tokens_per_step - 0) // ct)
+            capd = max(1, (cfg.max_tokens_per_step - nd_full) // ct)
+
+            def nts(cap):
+                vals = {cap}
+                b = 1
+                while b <= cap:
+                    vals.add(b)
+                    b *= 2
+                return vals
+
+            for nt in nts(cap0):
+                combos.add((1, 0, nt))
+            for nt in nts(capd) | {0}:
+                combos.add((k, nd_full, nt))
+        else:
+            for b in [0] + self._buckets:
+                combos.add((1, 0, b) if b else None)
+                combos.add((k, nd_full, b))
+            combos.discard(None)
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.params)
+        cache_abs = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.cache)
+        st_abs = jax.ShapeDtypeStruct((cfg.max_seqs + 1,), jnp.int32)
+        # table widths must match what _table_view will actually dispatch
+        # (jit caches are shape-keyed; warming the wrong width warms nothing)
+        mb = cfg.max_blocks_per_seq
+        if mb <= 64:
+            widths = [mb]
+        else:
+            widths, b = [], 16
+            while b < mb:
+                widths.append(b)
+                b *= 4
+            widths.append(mb)
+        rng_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        n = 0
+        combos = {(kk, nd, nt, w) for kk, nd, nt in combos for w in widths}
+        for kk, nd, nt, w in sorted(combos):
+            bt_abs = jax.ShapeDtypeStruct(
+                (self.block_tables.shape[0], w), jnp.int32)
+            if ct:
+                t_total = nd + nt * ct
+            else:
+                t_total = nd if nt == 0 else nt  # flat: nt carries the bucket
+            if t_total <= 0 or t_total < nd \
+                    or t_total > cfg.max_tokens_per_step + nd:
+                continue
+            i32 = lambda s: jax.ShapeDtypeStruct((s,), jnp.int32)  # noqa: E731
+            f32 = lambda s: jax.ShapeDtypeStruct((s,), jnp.float32)  # noqa: E731
+            fn = self._get_fused_chunk(kk, nd, nt if ct else 0, sampled,
+                                       has_tk, has_tp)
+            try:
+                fn.lower(
+                    abstract, cache_abs, st_abs,
+                    i32(t_total), i32(t_total), i32(t_total),
+                    i32(max(nd, 1)), i32(max(nd, 1)), i32(t_total),
+                    i32(max(nt if ct else 1, 1)),
+                    i32(max(nt if ct else 1, 1)),
+                    i32(max(nt if ct else 1, 1)),
+                    bt_abs, rng_abs, f32(t_total), i32(t_total),
+                    f32(t_total),
+                ).compile()
+                n += 1
+            except Exception as e:  # pragma: no cover - environment-specific
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning("warmup: combo (k=%s nd=%s nt=%s) failed to "
+                               "precompile: %s", kk, nd, nt, e)
+        return n
+
     def _dispatch_fused(self) -> bool:
         """Schedule + dispatch ONE fused chunk from host state (no readback).
         Returns False when nothing is schedulable."""
@@ -600,8 +712,14 @@ class RaggedInferenceEngine:
             decs.append((seq, k_s))
             if len(decs) >= min(budget, cfg.max_seqs):
                 break
+        # the decode region is all-or-nothing (0 or the max_seqs bucket):
+        # per-count buckets looked cheaper per step but every (k, nd, nt,
+        # width) combo is a separate compiled program, and on a remote-
+        # compile transport the staggered-arrival shape zoo cost seconds of
+        # mid-serve compilation per novel combo — far more than the padded
+        # rows cost (they ride the scratch slot)
         nd = (0 if not decs
-              else next(b for b in self._dec_buckets if b >= len(decs)))
+              else next(b for b in self._dec_buckets if b >= cfg.max_seqs))
 
         # prefill chunks after the decode region
         chunks: list[tuple[_SeqState, int, int]] = []  # (seq, start, take)
@@ -687,6 +805,9 @@ class RaggedInferenceEngine:
 
         rng = jax.random.fold_in(self._dispatch_rng, self._chunk_counter)
         self._chunk_counter += 1
+        max_pos = max(
+            [seq.pos + k_s - 1 for seq, k_s in decs]
+            + [seq.pos - 1 for seq, _, _ in chunks], default=0)
         fn = self._get_fused_chunk(k, nd, nt, sampled,
                                    bool(topk.any()),
                                    bool((topp < 1.0).any()))
@@ -695,7 +816,7 @@ class RaggedInferenceEngine:
             jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(positions),
             jnp.asarray(feed_sel), jnp.asarray(dec_remaining),
             jnp.asarray(pf_last), jnp.asarray(ts), jnp.asarray(tpos),
-            jnp.asarray(tval), jnp.asarray(self.block_tables), rng,
+            jnp.asarray(tval), jnp.asarray(self._table_view(max_pos)), rng,
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
         )
         self.dispatch_count += 1
@@ -917,7 +1038,7 @@ class RaggedInferenceEngine:
             self.params, self.cache,
             jnp.asarray(tokens[:bucket]), jnp.asarray(slots[:bucket]),
             jnp.asarray(positions[:bucket]),
-            jnp.asarray(self.block_tables),
+            jnp.asarray(self._table_view(int(positions[:n].max(initial=0)))),
         )
         self.dispatch_count += 1
         return self._emit_tokens(logits, emit)
@@ -985,13 +1106,14 @@ class RaggedInferenceEngine:
         self.tokens_padded += total - n_dec - sched
 
         step_fn = self._get_tiled_step(nd, nt)
+        max_pos = int(positions[:total].max(initial=0)) if total else 0
         logits, self.cache = step_fn(
             self.params, self.cache,
             jnp.asarray(tokens[:total]), jnp.asarray(slots[:total]),
             jnp.asarray(positions[:total]),
             jnp.asarray(ts[:max(nt, 1)]), jnp.asarray(tp[:max(nt, 1)]),
             jnp.asarray(tv[:max(nt, 1)]),
-            jnp.asarray(self.block_tables),
+            jnp.asarray(self._table_view(max_pos)),
         )
         self.dispatch_count += 1
         return self._emit_tokens(logits, emit)

@@ -103,7 +103,7 @@ class ShardCtx:
         return int(self.mesh.shape.get("pipeline", 1))
 
     def layer_stack(self, layer_fn, stacked_params, x, pld_theta=None,
-                    pld_rng=None):
+                    pld_rng=None, ltd_keep: int = 0, ltd_rng=None):
         """Run the decoder stack: plain ``lax.scan`` normally, the collective
         microbatch pipeline when the ``pipeline`` mesh axis is active.
 
@@ -111,8 +111,55 @@ class ShardCtx:
         stochastically skipped per Progressive Layer Drop
         (``runtime/progressive_layer_drop.py``): depth-scaled keep
         probability, ``lax.cond`` so dropped layers skip their FLOPs, and
-        stochastic-depth rescaling of the kept residual delta."""
+        stochastic-depth rescaling of the kept residual delta.
+
+        With ``ltd_keep`` (STATIC int < seq) + ``ltd_rng``, each layer
+        processes only a per-layer random subset of ``ltd_keep`` token
+        positions — random layerwise token dropping (reference
+        ``runtime/data_pipeline/data_routing/basic_layer.py`` +
+        ``csrc/random_ltd`` gather/scatter kernels): dropped tokens BYPASS
+        the layer (identity residual), kept tokens are gathered, processed
+        with their ORIGINAL positions, and scattered back, so gradients flow
+        through both routes. ``ltd_keep`` is static because it is a shape;
+        the engine buckets the schedule and compiles once per bucket."""
         import jax.lax as lax
+
+        if ltd_keep and pld_theta is not None:
+            raise ValueError("random_ltd and progressive_layer_drop do not "
+                             "compose (both rewrite the layer stack)")
+        if ltd_keep:
+            if self.pp_degree > 1:
+                raise ValueError("random_ltd does not compose with pipeline "
+                                 "parallelism")
+            leaves = jax.tree_util.tree_leaves(stacked_params)
+            n_layers = leaves[0].shape[0]
+            s = x.shape[1]
+            if not 0 < ltd_keep < s:
+                raise ValueError(f"ltd_keep must be in (0, seq={s}), got "
+                                 f"{ltd_keep}")
+
+            def body(carry, inp):
+                lp, i = inp
+                r = jax.random.fold_in(ltd_rng, i)
+                # first position always kept (reference keeps attention
+                # sinks stable); remaining K-1 sampled without replacement
+                perm = 1 + jax.random.permutation(r, s - 1)[: ltd_keep - 1]
+                keep = jnp.sort(jnp.concatenate(
+                    [jnp.zeros((1,), perm.dtype), perm]))
+                sub = jnp.take(carry, keep, axis=1)
+                pos = jnp.broadcast_to(keep[None, :],
+                                       (carry.shape[0], ltd_keep))
+                try:
+                    sub = layer_fn(sub, lp, positions=pos)
+                except TypeError as e:
+                    # position-free layers (learned embeddings already in x)
+                    if "positions" not in str(e):
+                        raise
+                    sub = layer_fn(sub, lp)
+                return carry.at[:, keep].set(sub.astype(carry.dtype)), None
+
+            return lax.scan(body, x,
+                            (stacked_params, jnp.arange(n_layers)))[0]
 
         if pld_theta is not None:
             if self.pp_degree > 1:
@@ -261,6 +308,9 @@ class ModelSpec:
     # whether loss_fn honors batch["pld_theta"] (progressive layer drop);
     # the engine refuses to enable PLD on models that would silently ignore it
     supports_pld: bool = False
+    # loss_fn accepts the static ltd_keep kwarg (random layerwise token
+    # dropping inside the decoder scan; ShardCtx.layer_stack)
+    supports_random_ltd: bool = False
     # param names kept dense under weight-only quantization (tables the model
     # indexes rather than matmuls, e.g. embeddings)
     woq_skip: tuple = ("embed",)

@@ -115,7 +115,7 @@ def _block(cfg: GPT2Config, ctx: ShardCtx, attn_impl: str, x, lp):
 
 def forward(cfg: GPT2Config, params, input_ids, ctx: ShardCtx | None = None,
             attn_impl: str = "auto", remat: bool = False, remat_policy=None,
-            pld_theta=None, pld_rng=None):
+            pld_theta=None, pld_rng=None, ltd_keep: int = 0, ltd_rng=None):
     ctx = ctx or ShardCtx()
     b, s = input_ids.shape
     x = params["wte"][input_ids] + params["wpe"][:s][None, :, :]
@@ -125,10 +125,135 @@ def forward(cfg: GPT2Config, params, input_ids, ctx: ShardCtx | None = None,
     if remat:
         layer = jax.checkpoint(layer, policy=remat_policy)
     x = ctx.layer_stack(layer, params["layers"], x,
-                        pld_theta=pld_theta, pld_rng=pld_rng)
+                        pld_theta=pld_theta, pld_rng=pld_rng,
+                        ltd_keep=ltd_keep, ltd_rng=ltd_rng)
     x = layernorm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
     logits = x @ params["wte"].T.astype(x.dtype)  # tied head
     return ctx.constrain(logits, "batch", "seq", "vocab_act")
+
+
+# ------------------------------------------------------------------ inference
+def init_cache(cfg: GPT2Config, batch: int, max_len: int,
+               dtype=jnp.bfloat16) -> dict:
+    """Dense fixed-shape KV cache [L, B, max_len, H, Dh] (v1 engine)."""
+    shape = (cfg.num_layers, batch, max_len, cfg.num_heads, cfg.hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_block(cfg: GPT2Config, x, lp, k_cache, v_cache, start_pos,
+                  max_len: int):
+    from deepspeed_tpu.ops.attention import xla_attention
+    from deepspeed_tpu.ops.quantizer import dequantize_layer
+
+    lp = dequantize_layer(lp, x.dtype)
+    b, t, d = x.shape
+    h = layernorm(x, lp["ln1_g"], lp["ln1_b"], cfg.layer_norm_eps)
+    q = (h @ lp["wq"] + lp["bq"]).reshape(b, t, cfg.num_heads, cfg.hd)
+    kk = (h @ lp["wk"] + lp["bk"]).reshape(b, t, cfg.num_heads, cfg.hd)
+    vv = (h @ lp["wv"] + lp["bv"]).reshape(b, t, cfg.num_heads, cfg.hd)
+    k_cache = lax.dynamic_update_slice(
+        k_cache, kk.astype(k_cache.dtype), (0, start_pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, vv.astype(v_cache.dtype), (0, start_pos, 0, 0))
+    q_pos = start_pos + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(max_len)[None, :]
+    bias = jnp.where(k_pos <= q_pos, 0.0, -1e30)[None, None]
+    o = xla_attention(q, k_cache, v_cache, causal=False, bias=bias)
+    x = x + o.reshape(b, t, d) @ lp["wo"] + lp["bo"]
+    h = layernorm(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
+    h = jax.nn.gelu(h @ lp["w_in"] + lp["b_in"], approximate=True)
+    return x + h @ lp["w_out"] + lp["b_out"], k_cache, v_cache
+
+
+def decode_forward(cfg: GPT2Config, params, tokens, cache, start_pos,
+                   ctx: ShardCtx | None = None):
+    """[B, T] new tokens + cache -> ([B, T, V] logits, cache)."""
+    del ctx
+    max_len = cache["k"].shape[2]
+    b, t = tokens.shape
+    pos = start_pos + jnp.arange(t)
+    x = (params["wte"][tokens] + params["wpe"][pos][None]).astype(
+        cache["k"].dtype)
+
+    def body(x, lp_kv):
+        lp, kc, vc = lp_kv
+        x, kc, vc = _cached_block(cfg, x, lp, kc, vc, start_pos, max_len)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(body, x,
+                                 (params["layers"], cache["k"], cache["v"]))
+    x = layernorm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    from deepspeed_tpu.ops.quantizer import maybe_dequantize
+
+    logits = x @ maybe_dequantize(params["wte"], x.dtype).astype(x.dtype).T
+    return logits, {"k": new_k, "v": new_v}
+
+
+def init_paged_cache(cfg: GPT2Config, num_blocks: int, block_size: int,
+                     dtype=jnp.bfloat16) -> dict:
+    """Blocked KV pool [L, num_blocks, block_size, H, Dh] (ragged engine)."""
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_heads, cfg.hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _ragged_block(cfg: GPT2Config, x, lp, kc, vc, positions, slots,
+                  block_tables, prefill_tiles=None):
+    from deepspeed_tpu.ops.attention import (
+        paged_attention,
+        ragged_prefill_attention,
+    )
+    from deepspeed_tpu.ops.quantizer import dequantize_layer
+
+    lp = dequantize_layer(lp, x.dtype)
+    t_tokens, d = x.shape
+    bs = kc.shape[1]
+    h = layernorm(x, lp["ln1_g"], lp["ln1_b"], cfg.layer_norm_eps)
+    q = (h @ lp["wq"] + lp["bq"]).reshape(t_tokens, cfg.num_heads, cfg.hd)
+    kk = (h @ lp["wk"] + lp["bk"]).reshape(t_tokens, cfg.num_heads, cfg.hd)
+    vv = (h @ lp["wv"] + lp["bv"]).reshape(t_tokens, cfg.num_heads, cfg.hd)
+    blk = block_tables[slots, positions // bs]
+    off = positions % bs
+    kc = kc.at[blk, off].set(kk.astype(kc.dtype))
+    vc = vc.at[blk, off].set(vv.astype(vc.dtype))
+    if prefill_tiles is None:
+        o = paged_attention(q, kc, vc, slots, positions, block_tables)
+    else:
+        n_dec, ts, tp, tv, ct = prefill_tiles
+        parts = []
+        if n_dec:
+            parts.append(paged_attention(q[:n_dec], kc, vc, slots[:n_dec],
+                                         positions[:n_dec], block_tables))
+        if t_tokens > n_dec:
+            parts.append(ragged_prefill_attention(
+                q[n_dec:], kc, vc, ts, tp, tv, block_tables, ct))
+        o = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    x = x + o.astype(x.dtype).reshape(t_tokens, d) @ lp["wo"] + lp["bo"]
+    h = layernorm(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
+    h = jax.nn.gelu(h @ lp["w_in"] + lp["b_in"], approximate=True)
+    return x + h @ lp["w_out"] + lp["b_out"], kc, vc
+
+
+def ragged_forward(cfg: GPT2Config, params, tokens, slots, positions,
+                   block_tables, cache, prefill_tiles=None):
+    """Flat ragged step: [T] mixed tokens -> ([T, V] logits, cache).
+    Learned positional embeddings ride the per-token ``positions`` the
+    ragged layout already carries."""
+    x = (params["wte"][tokens] + params["wpe"][positions]).astype(
+        cache["k"].dtype)
+
+    def body(x, lp_kv):
+        lp, kc, vc = lp_kv
+        x, kc, vc = _ragged_block(cfg, x, lp, kc, vc, positions, slots,
+                                  block_tables, prefill_tiles=prefill_tiles)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(body, x,
+                                 (params["layers"], cache["k"], cache["v"]))
+    x = layernorm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
+    from deepspeed_tpu.ops.quantizer import maybe_dequantize
+
+    logits = x @ maybe_dequantize(params["wte"], x.dtype).astype(x.dtype).T
+    return logits, {"k": new_k, "v": new_v}
 
 
 def num_params(cfg: GPT2Config) -> int:
@@ -149,11 +274,16 @@ def build(cfg: GPT2Config, ctx: ShardCtx | None = None, attn_impl: str = "auto",
     fwd = partial(forward, cfg, ctx=ctx, attn_impl=attn_impl,
                   remat=remat, remat_policy=remat_policy)
 
-    def loss_fn(params, batch, rng=None):
+    def loss_fn(params, batch, rng=None, ltd_keep: int = 0):
         pld = batch.get("pld_theta")
         if pld is not None and rng is None:
             raise ValueError("progressive layer drop needs the loss rng")
-        logits = fwd(params, batch["input_ids"], pld_theta=pld, pld_rng=rng)
+        if ltd_keep and rng is None:
+            raise ValueError("random_ltd needs the loss rng")
+        logits = fwd(params, batch["input_ids"], pld_theta=pld, pld_rng=rng,
+                     ltd_keep=ltd_keep,
+                     ltd_rng=(jax.random.fold_in(rng, 0x17D)
+                              if ltd_keep else None))
         return causal_lm_loss(logits, batch["input_ids"], batch.get("labels"))
 
     return ModelSpec(
@@ -167,5 +297,11 @@ def build(cfg: GPT2Config, ctx: ShardCtx | None = None, attn_impl: str = "auto",
         num_params=num_params(cfg),
         flops_per_token=partial(flops_per_token, cfg),
         supports_pld=True,
+        supports_random_ltd=True,
         woq_skip=("wte", "wpe"),
+        init_cache_fn=partial(init_cache, cfg),
+        decode_fn=partial(decode_forward, cfg, ctx=ctx),
+        init_paged_cache_fn=partial(init_paged_cache, cfg),
+        ragged_forward_fn=partial(ragged_forward, cfg),
+        supports_prefill_tiles=True,
     )

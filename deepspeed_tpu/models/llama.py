@@ -155,7 +155,8 @@ def _decoder_layer(cfg: LlamaConfig, ctx: ShardCtx, attn_impl: str,
 def hidden_states(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
                   ctx: ShardCtx | None = None, attn_impl: str = "auto",
                   remat_policy=None, remat: bool = False,
-                  pld_theta=None, pld_rng=None) -> jnp.ndarray:
+                  pld_theta=None, pld_rng=None, ltd_keep: int = 0,
+                  ltd_rng=None) -> jnp.ndarray:
     """[B, S] int tokens -> [B, S, D] final (post-norm) hidden states."""
     ctx = ctx or ShardCtx()
     x = ctx.embed_lookup(params["embed"], input_ids, "batch", "seq", "embed_act")
@@ -165,7 +166,8 @@ def hidden_states(cfg: LlamaConfig, params: dict, input_ids: jnp.ndarray,
         layer = jax.checkpoint(layer, policy=remat_policy)
 
     x = ctx.layer_stack(layer, params["layers"], x,
-                        pld_theta=pld_theta, pld_rng=pld_rng)
+                        pld_theta=pld_theta, pld_rng=pld_rng,
+                        ltd_keep=ltd_keep, ltd_rng=ltd_rng)
     return rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
 
 
@@ -413,23 +415,33 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
     fwd = partial(forward, cfg, ctx=ctx, attn_impl=attn_impl,
                   remat=remat, remat_policy=remat_policy)
 
-    def loss_fn(params, batch, rng=None):
+    def loss_fn(params, batch, rng=None, ltd_keep: int = 0):
         # progressive layer drop: the engine injects a traced theta into the
-        # batch (runtime/progressive_layer_drop.py); rng drives the drops
+        # batch (runtime/progressive_layer_drop.py); rng drives the drops.
+        # ltd_keep (STATIC): random layerwise token dropping — the engine
+        # passes the bucketed schedule value and compiles per bucket.
         pld = batch.get("pld_theta")
         if pld is not None and rng is None:
             raise ValueError("progressive layer drop needs the loss rng")
-        if ctx.loss_tile_size:
+        if ltd_keep and rng is None:
+            raise ValueError("random_ltd needs the loss rng")
+        ltd_rng = (jax.random.fold_in(rng, 0x17D) if ltd_keep else None)
+        if ctx.loss_tile_size or ltd_keep:
             from deepspeed_tpu.parallel.sequence_tiling import tiled_causal_lm_loss
 
             x = hidden_states(cfg, params, batch["input_ids"], ctx=ctx,
                               attn_impl=attn_impl, remat=remat,
                               remat_policy=remat_policy,
-                              pld_theta=pld, pld_rng=rng)
-            return tiled_causal_lm_loss(
-                x, lm_head(cfg, params), batch["input_ids"],
-                batch.get("labels"), tile_size=ctx.loss_tile_size,
-            )
+                              pld_theta=pld, pld_rng=rng,
+                              ltd_keep=ltd_keep, ltd_rng=ltd_rng)
+            if ctx.loss_tile_size:
+                return tiled_causal_lm_loss(
+                    x, lm_head(cfg, params), batch["input_ids"],
+                    batch.get("labels"), tile_size=ctx.loss_tile_size,
+                )
+            logits = x @ lm_head(cfg, params).astype(x.dtype)
+            return causal_lm_loss(logits, batch["input_ids"],
+                                  batch.get("labels"))
         logits = fwd(params, batch["input_ids"], pld_theta=pld, pld_rng=rng)
         return causal_lm_loss(logits, batch["input_ids"], batch.get("labels"))
 
@@ -453,4 +465,5 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         supports_prefill_tiles=True,
         pipeline_parts=pipeline_parts(cfg, ctx=ctx, attn_impl=attn_impl),
         supports_pld=True,
+        supports_random_ltd=True,
     )

@@ -164,6 +164,179 @@ def forward(cfg: MixtralConfig, params, input_ids, ctx: ShardCtx | None = None,
     return logits
 
 
+# ------------------------------------------------------------------ inference
+def _moe_infer(h: jnp.ndarray, router_w, w_gate, w_up, w_down,
+               top_k: int) -> jnp.ndarray:
+    """Dropless per-token top-k MoE for the inference paths (``h`` [T, D]
+    flat tokens).
+
+    Role parity with the reference's ragged MoE serving stack
+    (``inference/v2/model_implementations/mixtral/model.py`` +
+    ``inference/v2/kernels/ragged_ops`` top-k gating, MoE gather/scatter):
+    the CUDA version compacts tokens per expert with gather/scatter kernels;
+    the TPU-native shape is a batched [E] einsum — every expert processes
+    every token on the MXU and the router's renormalized top-k weights
+    combine the results. Exact (no capacity, no drops), at E/top_k x the
+    ideal expert FLOPs — the right trade at serving token counts, where the
+    expert GEMMs are small and a compaction pass would serialize; a
+    sort-based exact dispatch is the optimization point if prefill chunks
+    ever dominate.
+    """
+    t, d = h.shape
+    probs = jax.nn.softmax(
+        h.astype(jnp.float32) @ router_w.astype(jnp.float32), axis=-1)
+    topv, topi = lax.top_k(probs, top_k)
+    topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
+    e = probs.shape[-1]
+    w = jnp.zeros((t, e), jnp.float32).at[
+        jnp.arange(t)[:, None], topi].set(topv)
+    dtype = h.dtype
+    g = jnp.einsum("td,edf->tef", h, w_gate.astype(dtype))
+    u = jnp.einsum("td,edf->tef", h, w_up.astype(dtype))
+    y = jnp.einsum("tef,efd->ted", jax.nn.silu(g) * u, w_down.astype(dtype))
+    return jnp.einsum("ted,te->td", y, w.astype(dtype))
+
+
+def init_cache(cfg: MixtralConfig, batch: int, max_len: int,
+               dtype=jnp.bfloat16) -> dict:
+    """Dense fixed-shape KV cache [L, B, max_len, Hkv, Dh] (v1 engine)."""
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_layer(cfg: MixtralConfig, x, lp, k_cache, v_cache, start_pos,
+                  max_len: int):
+    from deepspeed_tpu.ops.quantizer import dequantize_layer
+    from deepspeed_tpu.ops.attention import xla_attention
+
+    lp = dequantize_layer(lp, x.dtype)
+    b, t, d = x.shape
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(b, t, hq, hd)
+    kk = (h @ lp["wk"]).reshape(b, t, hkv, hd)
+    vv = (h @ lp["wv"]).reshape(b, t, hkv, hd)
+    positions = start_pos + jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    q, kk = apply_rope(q, kk, positions, cfg.rope_theta)
+    k_cache = lax.dynamic_update_slice(
+        k_cache, kk.astype(k_cache.dtype), (0, start_pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, vv.astype(v_cache.dtype), (0, start_pos, 0, 0))
+    q_pos = start_pos + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(max_len)[None, :]
+    bias = jnp.where(k_pos <= q_pos, 0.0, -1e30)[None, None]
+    o = xla_attention(q, k_cache, v_cache, causal=False, bias=bias)
+    x = x + o.reshape(b, t, hq * hd) @ lp["wo"]
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    y = _moe_infer(h.reshape(b * t, d), lp["router"], lp["w_gate"],
+                   lp["w_up"], lp["w_down"], cfg.top_k)
+    return x + y.reshape(b, t, d), k_cache, v_cache
+
+
+def decode_forward(cfg: MixtralConfig, params, tokens, cache, start_pos,
+                   ctx: ShardCtx | None = None):
+    """[B, T] new tokens + cache -> ([B, T, V] logits, cache); prefill
+    (T = prompt) and incremental decode (T = 1) share the program."""
+    del ctx
+    max_len = cache["k"].shape[2]
+    x = params["embed"][tokens].astype(cache["k"].dtype)
+
+    def body(x, lp_kv):
+        lp, kc, vc = lp_kv
+        x, kc, vc = _cached_layer(cfg, x, lp, kc, vc, start_pos, max_len)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(body, x,
+                                 (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    from deepspeed_tpu.ops.quantizer import maybe_dequantize
+
+    logits = x @ maybe_dequantize(params["lm_head"], x.dtype).astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def init_paged_cache(cfg: MixtralConfig, num_blocks: int, block_size: int,
+                     dtype=jnp.bfloat16) -> dict:
+    """Blocked KV pool [L, num_blocks, block_size, Hkv, Dh] (ragged engine;
+    block 0 is the scratch block padding tokens write into)."""
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _ragged_layer(cfg: MixtralConfig, x, lp, kc, vc, positions, slots,
+                  block_tables, prefill_tiles=None):
+    """One decoder layer over a flat ragged token batch [T, D]: paged
+    attention identical to the Llama ragged layer, MoE FFN routed per token
+    (decode tokens route through the SAME per-token top-k machinery as
+    prefill-chunk tokens — MoE over a paged cache is a routing problem only
+    in the FFN, which is position-free)."""
+    from deepspeed_tpu.ops.attention import (
+        paged_attention,
+        ragged_prefill_attention,
+    )
+    from deepspeed_tpu.ops.quantizer import dequantize_layer
+
+    lp = dequantize_layer(lp, x.dtype)
+    t_tokens, d = x.shape
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    bs = kc.shape[1]
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(t_tokens, hq, hd)
+    kk = (h @ lp["wk"]).reshape(t_tokens, hkv, hd)
+    vv = (h @ lp["wv"]).reshape(t_tokens, hkv, hd)
+    q, kk = apply_rope(q[None], kk[None], positions[None], cfg.rope_theta)
+    q, kk = q[0], kk[0]
+
+    blk = block_tables[slots, positions // bs]
+    off = positions % bs
+    kc = kc.at[blk, off].set(kk.astype(kc.dtype))
+    vc = vc.at[blk, off].set(vv.astype(vc.dtype))
+
+    if prefill_tiles is None:
+        o = paged_attention(q, kc, vc, slots, positions, block_tables)
+    else:
+        n_dec, ts, tp, tv, ct = prefill_tiles
+        parts = []
+        if n_dec:
+            parts.append(paged_attention(q[:n_dec], kc, vc, slots[:n_dec],
+                                         positions[:n_dec], block_tables))
+        if t_tokens > n_dec:
+            parts.append(ragged_prefill_attention(
+                q[n_dec:], kc, vc, ts, tp, tv, block_tables, ct))
+        o = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    x = x + o.astype(x.dtype).reshape(t_tokens, hq * hd) @ lp["wo"]
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    x = x + _moe_infer(h, lp["router"], lp["w_gate"], lp["w_up"],
+                       lp["w_down"], cfg.top_k)
+    return x, kc, vc
+
+
+def ragged_forward(cfg: MixtralConfig, params, tokens, slots, positions,
+                   block_tables, cache, prefill_tiles=None):
+    """Flat ragged step: [T] mixed tokens -> ([T, V] logits, cache) — the
+    MoE member of the continuous-batching engine (reference
+    ``inference/v2/model_implementations/mixtral``)."""
+    x = params["embed"][tokens].astype(cache["k"].dtype)
+
+    def body(x, lp_kv):
+        lp, kc, vc = lp_kv
+        x, kc, vc = _ragged_layer(cfg, x, lp, kc, vc, positions, slots,
+                                  block_tables, prefill_tiles=prefill_tiles)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(body, x,
+                                 (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    from deepspeed_tpu.ops.quantizer import maybe_dequantize
+
+    logits = x @ maybe_dequantize(params["lm_head"], x.dtype).astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def num_params(cfg: MixtralConfig) -> int:
     d, f, hd, e = cfg.hidden_size, cfg.intermediate_size, cfg.hd, cfg.num_experts
     per_layer = (d * hd * (cfg.num_heads * 2 + cfg.num_kv_heads * 2) + d * e
@@ -206,4 +379,9 @@ def build(cfg: MixtralConfig, ctx: ShardCtx | None = None, attn_impl: str = "aut
                            "experts": cfg.num_experts},
         num_params=num_params(cfg),
         flops_per_token=partial(flops_per_token, cfg),
+        init_cache_fn=partial(init_cache, cfg),
+        decode_fn=partial(decode_forward, cfg, ctx=ctx),
+        init_paged_cache_fn=partial(init_paged_cache, cfg),
+        ragged_forward_fn=partial(ragged_forward, cfg),
+        supports_prefill_tiles=True,
     )

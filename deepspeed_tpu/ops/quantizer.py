@@ -67,6 +67,32 @@ def dequantize(qt: QuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
     return _from_blocks(vals, qt.shape, dtype)
 
 
+def quantize_signs(x: jnp.ndarray, block: int = 256):
+    """1-bit quantization (reference ``compressed_allreduce`` payload,
+    ``runtime/comm/nccl.py:17`` / ``csrc/quantization/quant_reduce.cu``):
+    sign bits packed 8-per-byte + per-block mean-|x| scales. Returns
+    ``(packed uint8 [N/8], scales f32 [N/block])`` over the flattened,
+    block-padded input; ``block`` must be a multiple of 8."""
+    assert block % 8 == 0, block
+    blocks, _ = _to_blocks(x.astype(jnp.float32), block)
+    scales = jnp.mean(jnp.abs(blocks), axis=-1)
+    bits = (blocks >= 0).astype(jnp.uint8).reshape(-1, 8)
+    weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+    packed = jnp.sum(bits * weights[None, :], axis=-1, dtype=jnp.uint8)
+    return packed, scales
+
+
+def dequantize_signs(packed: jnp.ndarray, scales: jnp.ndarray, size: int,
+                     block: int = 256, dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`quantize_signs`: ±scale per element, first ``size``
+    elements (flat)."""
+    weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+    bits = (packed.reshape(-1, 1) & weights[None, :]) > 0
+    signs = jnp.where(bits, 1.0, -1.0).reshape(-1, block)
+    vals = signs * scales[:, None]
+    return vals.reshape(-1)[:size].astype(dtype)
+
+
 def quantize_rows(x: jnp.ndarray, block: int = 128):
     """Shape-preserving symmetric int8 quantization with per-block scales
     along the LAST dim: ``x [..., L] -> (q int8 [..., L], scales [..., L/block])``.

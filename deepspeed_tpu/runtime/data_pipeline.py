@@ -67,6 +67,64 @@ def apply_seqlen_curriculum(batch: dict, seq_len: int) -> dict:
     return out
 
 
+def dynamic_batches(lengths, max_tokens: int, bucket_step: int = 64,
+                    rng: np.random.Generator | None = None,
+                    min_batch: int = 1, rows_multiple_of: int = 1):
+    """Seqlen-bucketed dynamic batching (reference ``runtime/data_pipeline/
+    data_sampling`` variable-batch-size utilities): group samples by padded
+    length bucket and pack each batch to a TOKEN budget instead of a fixed
+    row count — long-sequence batches get fewer rows, short ones more, so
+    step cost stays ~constant and padding waste stays bounded by
+    ``bucket_step``.
+
+    Returns ``[(indices, padded_len)]``; every sample appears at least once.
+    Shapes stay bucketed (padded_len is a bucket_step multiple), so the
+    compiled-program count is bounded the same way every other dimension in
+    this framework is. ``rows_multiple_of``: round every batch's row count
+    to a multiple (the engine's batch dim must divide the dp world); tail
+    batches wrap around within their bucket (the standard drop-nothing
+    remedy — a few samples repeat).
+    """
+    lengths = np.asarray(lengths)
+    if (lengths <= 0).any():
+        raise ValueError("dynamic_batches: lengths must be positive")
+    m = max(1, rows_multiple_of)
+    buckets: dict[int, list[int]] = {}
+    for i, n in enumerate(lengths):
+        padded = int(-(-int(n) // bucket_step) * bucket_step)
+        buckets.setdefault(padded, []).append(i)
+    out = []
+    for padded in sorted(buckets):
+        idx = buckets[padded]
+        if rng is not None:
+            idx = list(rng.permutation(idx))
+        rows = max(min_batch, max_tokens // padded, m)
+        rows = -(-rows // m) * m  # round UP: keeps both floors
+        for s in range(0, len(idx), rows):
+            chunk = list(idx[s:s + rows])
+            short = (-len(chunk)) % m
+            if short:
+                chunk += [idx[(s + len(chunk) + j) % len(idx)]
+                          for j in range(short)]
+            out.append((chunk, padded))
+    if rng is not None:
+        order = rng.permutation(len(out))
+        out = [out[i] for i in order]
+    return out
+
+
+def pad_dynamic_batch(samples, indices, padded_len: int, pad_id: int = 0):
+    """Materialize one ``dynamic_batches`` entry: [len(indices), padded_len]
+    int32 ids + a same-shape attention mask."""
+    ids = np.full((len(indices), padded_len), pad_id, np.int32)
+    mask = np.zeros((len(indices), padded_len), np.int32)
+    for r, i in enumerate(indices):
+        tok = np.asarray(samples[i]).reshape(-1)[:padded_len]
+        ids[r, :len(tok)] = tok
+        mask[r, :len(tok)] = 1
+    return {"input_ids": ids, "attention_mask": mask}
+
+
 def random_ltd_drop(batch: dict, keep_ratio: float, rng: np.random.Generator,
                     protect_first: int = 1) -> dict:
     """Random layerwise-token-dropping analog at the data layer

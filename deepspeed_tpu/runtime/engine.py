@@ -426,6 +426,40 @@ class Engine:
                 "compression_training: "
                 f"{self._compression.config.enabled_methods()}", ranks=[0])
 
+        # random layerwise token dropping (reference data_routing/
+        # basic_layer.py): per-layer token subsets inside the decoder scan;
+        # the kept count is a SHAPE, so the schedule is bucketed and the
+        # step compiles once per bucket value (self._train_batch_jit is a
+        # per-bucket dict)
+        ltd_cfg = config.data_efficiency.random_ltd
+        self._ltd = ltd_cfg if ltd_cfg.enabled else None
+        self._ltd_active = 0
+        self._ltd_jits: dict = {}
+        if self._ltd is not None:
+            if not self.model_spec.supports_random_ltd:
+                raise ValueError(
+                    f"model {self.model_spec.name!r} does not support "
+                    "random_ltd (its loss_fn has no ltd_keep route); "
+                    "enabling it would silently train dense")
+            conflicts = {
+                "progressive_layer_drop": config.progressive_layer_drop.enabled,
+                "pipeline parallelism": topo.size("pipeline") > 1,
+                "quantized_gradients": bool(zero.quantized_gradients),
+                "offloaded optimizer state":
+                    zero.offload_optimizer.device != "none",
+                "zenflow": zero.zenflow.enabled,
+            }
+            bad = [k for k, v in conflicts.items() if v]
+            if bad:
+                raise ValueError(
+                    f"random_ltd does not compose with {', '.join(bad)} "
+                    "(each owns the step program this build specializes "
+                    "per kept-token bucket)")
+            log_dist(
+                f"random_ltd: keep ratio {ltd_cfg.start_keep_ratio:.0%} -> "
+                f"100% over {ltd_cfg.total_steps} steps, bucket "
+                f"{ltd_cfg.bucket} tokens", ranks=[0])
+
         # jax.profiler capture window + debug-nans trap (reference nvtx
         # instrumentation / sanity-check config, SURVEY §5.1-5.2)
         from deepspeed_tpu.utils.tracing import StepTracer
@@ -442,7 +476,22 @@ class Engine:
         # reduce ONCE at the boundary through int8 all-to-all/all-gather with
         # error feedback (comm/quantized_collectives.py)
         self._qgrad = bool(zero.quantized_gradients)
+        self._qgrad_bits = int(zero.quantized_gradients_bits)
         self._qgrad_error = None
+        # 1-bit-family optimizers compress AFTER their variance warmup
+        # (reference onebit/adam.py freeze_step two-phase protocol): the
+        # engine runs the dense-wire program until freeze_step, then the
+        # compressed program
+        self._qgrad_warmup_steps = 0
+        self._warm_batch_jit = None
+        if self._qgrad and config.optimizer.type.lower().replace("-", "_") in (
+                "onebit_adam", "onebitadam", "1bit_adam", "onebit_lamb",
+                "onebitlamb", "1bit_lamb", "zero_one_adam", "zerooneadam",
+                "01adam", "zoadam"):
+            op = dict(config.optimizer.params)
+            self._qgrad_warmup_steps = int(
+                op.get("freeze_step", op.get("warmup_steps",
+                                             op.get("var_freeze_step", 100))))
         if self._qgrad:
             others = [a for a in ("tensor", "sequence", "pipeline", "expert")
                       if topo.size(a) > 1]
@@ -477,10 +526,12 @@ class Engine:
                 ),
                 out_shardings=err_shardings,
             )()
-            log_dist("gradient reduction: int8 quantized (qgZ) over the data "
-                     f"axis (n={n}) with error feedback"
+            log_dist(f"gradient reduction: {self._qgrad_bits}-bit quantized "
+                     f"wire over the data axis (n={n}) with error feedback"
                      + (f", fsdp={topo.size('fsdp')} auto"
-                        if topo.size("fsdp") > 1 else ""), ranks=[0])
+                        if topo.size("fsdp") > 1 else "")
+                     + (f", dense until step {self._qgrad_warmup_steps}"
+                        if self._qgrad_warmup_steps else ""), ranks=[0])
 
         # ZenFlow split update over the offloaded tier (runtime/zenflow.py;
         # reference runtime/zenflow/zenflow_stage_1_and_2.py:47)
@@ -594,6 +645,17 @@ class Engine:
             filt, self.plan.grad_specs,
             is_leaf=lambda x: isinstance(x, PartitionSpec))
 
+    def _ltd_keep_for_step(self, step: int, seq: int) -> int:
+        """Kept tokens per layer this step (0 = dense): the reference
+        random-LTD seq schedule — linear ramp from start_keep_ratio back to
+        the full sequence over total_steps — bucketed so each value is one
+        compiled program."""
+        cfg = self._ltd
+        frac = min(1.0, step / max(1, cfg.total_steps))
+        ratio = cfg.start_keep_ratio + (1.0 - cfg.start_keep_ratio) * frac
+        k = int(-(-int(round(ratio * seq)) // cfg.bucket) * cfg.bucket)
+        return 0 if k >= seq else max(k, min(cfg.bucket, seq - 1))
+
     def _cast_params(self, params):
         """Compute-dtype view of the master params. Under parameter offload
         the stacked layers stay host-resident fp32 (the ShardCtx.param_stream
@@ -621,7 +683,13 @@ class Engine:
                 # it must sit inside each microbatch's grad tape, so it
                 # cannot be hoisted out of the GAS scan.
                 cp = self._compression.apply_to_params(cp, step)
-            loss = self.model_spec.loss_fn(cp, mb, rng)
+            if self._ltd_active:
+                # static kept-token count: this closure is traced once per
+                # bucket value (train_batch keys the jit cache by it)
+                loss = self.model_spec.loss_fn(cp, mb, rng,
+                                               ltd_keep=self._ltd_active)
+            else:
+                loss = self.model_spec.loss_fn(cp, mb, rng)
             return loss * scale
 
         loss_scaled, grads = jax.value_and_grad(scaled_loss)(cparams)
@@ -766,8 +834,8 @@ class Engine:
             acc, losses = jax.lax.scan(micro, acc0, (jnp.arange(gas), batch))
         return jnp.mean(losses), acc
 
-    def _build_train_batch_fn(self):
-        if self._qgrad:
+    def _build_train_batch_fn(self, use_qgrad: bool | None = None):
+        if self._qgrad if use_qgrad is None else use_qgrad:
             return self._build_train_batch_fn_qgrad()
         if (self.topo.size("pipeline") > 1
                 and self.config.pipeline.schedule == "1f1b"):
@@ -811,7 +879,8 @@ class Engine:
                 e_leaves = jax.tree_util.tree_leaves(qerr)
                 red, nerr = [], []
                 for g, e in zip(g_leaves, e_leaves):
-                    r, ne = quantized_all_reduce(g, AXIS_DATA, e[0])
+                    r, ne = quantized_all_reduce(g, AXIS_DATA, e[0],
+                                                 bits=self._qgrad_bits)
                     red.append(r)
                     nerr.append(ne[None])
                 return (jax.lax.pmean(loss, AXIS_DATA),
@@ -1354,9 +1423,35 @@ class Engine:
             return self._train_batch_grouped(batch)
         if self._train_batch_jit is None:
             self._train_batch_jit = self._build_train_batch_fn()
+        if self._ltd is not None:
+            seq = int(np.asarray(batch["input_ids"]).shape[-1])
+            k = self._ltd_keep_for_step(self.global_steps, seq)
+            # _ltd_active is read at TRACE time (jit traces on first call),
+            # so it must hold this dispatch's bucket; the per-bucket jit
+            # cache guarantees a cached program was traced with its own k
+            self._ltd_active = k
+            fn = self._ltd_jits.get(k)
+            if fn is None:
+                fn = self._build_train_batch_fn()
+                self._ltd_jits[k] = fn
+            self._train_batch_jit = fn
         dev_batch = self._put_gas_batch(batch)
         self.tput_timer.start()
-        if self._qgrad:
+        # 1-bit-family two-phase wire: dense program during the optimizer's
+        # variance warmup, compressed program after (reference onebit/adam.py
+        # all_reduce -> compressed_allreduce handoff at freeze_step)
+        in_dense_phase = (self._qgrad
+                          and self.global_steps < self._qgrad_warmup_steps)
+        if in_dense_phase:
+            if self._warm_batch_jit is None:
+                self._warm_batch_jit = self._build_train_batch_fn(
+                    use_qgrad=False)
+            self.params, self.opt_state, self.scale_state, metrics = \
+                self._warm_batch_jit(
+                    self.params, self.opt_state, self.scale_state,
+                    jnp.int32(self.global_steps), self._train_rng, dev_batch,
+                )
+        elif self._qgrad:
             (self.params, self.opt_state, self.scale_state, metrics,
              self._qgrad_error) = self._train_batch_jit(
                 self.params, self.opt_state, self.scale_state,
@@ -1812,6 +1907,24 @@ def initialize(
     if model is None:
         raise ValueError("initialize() requires a model (ModelSpec or builder callable)")
     cfg = load_config(config)
+    mics = cfg.zero_optimization.mics_shard_size
+    if mics > 0:
+        # MiCS (reference mics.py:63): shard degree = group size k < world.
+        # Derive the mesh split — fsdp=k intra-group, data=world/k replica
+        # groups — instead of making the user hand-shape the mesh.
+        from deepspeed_tpu.config.config import ConfigError
+
+        if cfg.mesh.is_explicit and cfg.mesh.fsdp not in (-1, 1, mics):
+            raise ConfigError(
+                f"mesh.fsdp={cfg.mesh.fsdp} contradicts "
+                f"zero_optimization.mics_shard_size={mics}; drop one")
+        for ax in ("tensor", "sequence", "expert", "pipeline"):
+            if getattr(cfg.mesh, ax) > 1:
+                raise ConfigError(
+                    f"mics_shard_size derives a data x fsdp mesh; it does "
+                    f"not compose with an explicit {ax} axis yet")
+        cfg.mesh.fsdp = mics
+        cfg.mesh.data = -1  # world / k replica groups
     if topology_initialized():
         topo = get_topology()
         # an EXPLICIT mesh request that contradicts the live topology must

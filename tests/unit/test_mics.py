@@ -1,0 +1,86 @@
+"""MiCS shard-group ZeRO-3 (reference ``runtime/zero/mics.py:63 MiCS_Init``
++ ``:361 MiCS_Optimizer``): shard degree bounded to a group of k < world
+devices, replicas across world/k groups, cross-group gradient sync."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.topology import reset_topology
+from deepspeed_tpu.config.config import ConfigError
+from deepspeed_tpu.models import llama
+
+VOCAB = 256
+
+
+def _engine(mics=0, mesh=None, stage=3, **zero_extra):
+    reset_topology()
+    cfg = {
+        "train_micro_batch_size_per_device": 2,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 0,
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage, "mics_shard_size": mics,
+                              **zero_extra},
+        "seed": 7,
+    }
+    if mesh is not None:
+        cfg["mesh"] = mesh
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(llama.LlamaConfig.tiny(VOCAB), ctx=ctx),
+        config=cfg, seed=11)
+    return engine
+
+
+def _losses(engine, n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [float(engine.train_batch(
+        {"input_ids": rng.integers(0, VOCAB, (32, 16), dtype=np.int32)}))
+        for _ in range(n)]
+
+
+class TestMics:
+    def test_layout_shard_degree_equals_group(self):
+        """Round-4 item 7 'done' criterion: shard degree = group size,
+        replicas across groups — params/grads/opt state shard over an fsdp
+        axis of size k, the data axis of size world/k replicates them."""
+        eng = _engine(mics=4)
+        assert eng.topo.size("fsdp") == 4
+        assert eng.topo.size("data") == 2
+        # a big stacked layer leaf: sharded over fsdp ONLY (not data)
+        spec = eng.plan.param_specs["layers"]["w_gate"]
+        flat = [e for e in spec if e is not None]
+        assert flat == ["fsdp"] or flat == [("fsdp",)], spec
+        # grads/opt state follow the same within-group layout (stage-3
+        # shard_specs == param_specs without hierarchical partitioning)
+        assert eng.plan.shard_specs["layers"]["w_gate"] == spec
+
+    def test_loss_parity_vs_explicit_mesh(self):
+        """mics_shard_size=k must train identically to the hand-shaped
+        {data: world/k, fsdp: k} mesh (it IS that mesh)."""
+        a = _losses(_engine(mics=4))
+        b = _losses(_engine(mesh={"data": 2, "fsdp": 4}))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_loss_parity_vs_full_world_fsdp(self):
+        """Bounding the shard group must not change the math, only the
+        layout: same trajectory as full-world ZeRO-3 within bf16 noise."""
+        a = _losses(_engine(mics=4))
+        b = _losses(_engine(mesh={"data": 1, "fsdp": 8}))
+        np.testing.assert_allclose(a, b, rtol=2e-2)
+        assert abs(a[0] - b[0]) < 1e-5
+
+    def test_requires_stage3(self):
+        with pytest.raises((ConfigError, ValueError), match="stage 3"):
+            _engine(mics=4, stage=2)
+
+    def test_conflicting_mesh_rejected(self):
+        with pytest.raises((ConfigError, ValueError), match="contradicts"):
+            _engine(mics=4, mesh={"data": 1, "fsdp": 8})
+
+    def test_conflicts_with_hpz(self):
+        with pytest.raises((ConfigError, ValueError), match="pick one"):
+            _engine(mics=4, hierarchical_partitioning=True)

@@ -63,6 +63,62 @@ class TestQuantizedAllReduce:
         assert a2a and any("s8[" in l for l in a2a), "all-to-all payload not int8"
         assert ag and any("s8[" in l for l in ag), "all-gather payload not int8"
 
+    @pytest.mark.parametrize("bits,dtype_tag,chunk_bytes", [
+        # for n=8 ranks, 4096 elements -> 512-element chunks: the per-chunk
+        # wire payload is 64 sign-bytes (1-bit, n/8) or 256 nibble-bytes
+        # (4-bit, n/2)
+        (1, "u8[", 64),
+        (4, "s8[", 256),
+    ])
+    def test_low_bit_wire_bytes(self, data_mesh, bits, dtype_tag, chunk_bytes):
+        """Round-4 item 4 'done' criterion: the all-to-all operand IS the
+        packed payload — byte count ~ n/8 (1-bit) and n/2 (int4). XLA may
+        lower the all-to-all as one [n, B] operand or a tuple of [1, B]
+        per-destination pieces; both count, as long as the payload bytes per
+        chunk match the packed size."""
+        x = jnp.zeros((8, 4096), jnp.float32)
+        f = jax.jit(lambda x, e: quantized_all_reduce_arrays(
+            x, e, data_mesh, "data", bits=bits, block=64))
+        txt = f.lower(x, jnp.zeros_like(x)).compile().as_text()
+        a2a = [l for l in txt.splitlines() if "all-to-all" in l
+               and dtype_tag in l]
+        assert a2a, f"no {dtype_tag} all-to-all operand (bits={bits})"
+        import re
+
+        sizes = set()
+        for line in a2a:
+            for m in re.finditer(re.escape(dtype_tag) + r"([0-9,]+)\]", line):
+                dims = [int(d) for d in m.group(1).split(",")]
+                p = 1
+                for d in dims:
+                    p *= d
+                sizes.add(p)
+        assert sizes & {chunk_bytes, 8 * chunk_bytes}, (sizes, chunk_bytes)
+
+    def test_one_bit_error_feedback_converges(self, data_mesh):
+        """1-bit wire + error feedback: the running average of repeated
+        reductions converges to the exact mean (the compressed-allreduce
+        guarantee 1-bit Adam is built on)."""
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(8, 512)).astype(np.float32))
+        e = jnp.zeros_like(x)
+        f = jax.jit(lambda x, e: quantized_all_reduce_arrays(
+            x, e, data_mesh, "data", bits=1, block=64))
+        true = np.asarray(x).mean(axis=0)
+        acc = np.zeros(512)
+        errs = {}
+        for i in range(240):
+            m, e = f(x, e)
+            acc += np.asarray(m)[0]
+            if i + 1 in (120, 240):
+                errs[i + 1] = np.abs(acc / (i + 1) - true).max()
+        one_shot = np.abs(np.asarray(f(x, jnp.zeros_like(x))[0])[0] - true).max()
+        # O(1/n) telescoping: doubling the horizon ~halves the running-mean
+        # error (measured 0.24 -> 0.127), and the long average beats the
+        # one-shot sign noise by >5x
+        assert errs[240] < one_shot / 5, (errs, one_shot)
+        assert errs[240] < errs[120] * 0.7, errs
+
 
 def _train(config_extra, optimizer=None, steps=6, seed=3, mesh=None, stage=1):
     reset_topology()
@@ -209,6 +265,59 @@ class TestOnebitLamb:
         # trust-ratio scaling makes LAMB deliberate at tiny scale: require
         # monotone-ish descent through the freeze point, not a big drop
         assert losses[-1] < losses[5] < losses[0], losses
+
+
+class TestOneBitWire:
+    """1-bit Adam with a REAL 1-bit wire (round-4 item 4): dense reduction
+    during freeze_step warmup, sign+scale compressed reduction after."""
+
+    def test_one_bit_adam_compressed_wire_parity(self):
+        opt = {"type": "onebit_adam", "params": {"lr": 5e-3, "freeze_step": 3}}
+        base = _train({}, optimizer=opt, steps=10)
+        comp = _train({"quantized_gradients": True,
+                       "quantized_gradients_bits": 1},
+                      optimizer=opt, steps=10)
+        assert comp[-1] < comp[0] * 0.9  # still converges on the 1-bit wire
+        # warmup steps are dense-wire: EXACTLY equal trajectories there
+        np.testing.assert_allclose(comp[:3], base[:3], rtol=1e-5)
+        # compressed phase tracks loosely (sign-only gradients)
+        np.testing.assert_allclose(comp, base, rtol=0.25)
+
+    def test_dense_phase_leaves_error_buffers_untouched(self):
+        """Observable phase switch: during freeze_step the compressed program
+        must not run, so the error-feedback residuals stay exactly zero."""
+        import deepspeed_tpu
+        from deepspeed_tpu.comm.topology import reset_topology
+
+        reset_topology()
+        cfg = {
+            "train_micro_batch_size_per_device": 2,
+            "gradient_accumulation_steps": 2,
+            "steps_per_print": 0,
+            "optimizer": {"type": "onebit_adam",
+                          "params": {"lr": 1e-3, "freeze_step": 4}},
+            "zero_optimization": {"stage": 1, "quantized_gradients": True,
+                                  "quantized_gradients_bits": 1},
+            "mesh": {"data": 8},
+            "seed": 7,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=lambda ctx: llama.build(llama.LlamaConfig.tiny(VOCAB),
+                                          ctx=ctx),
+            config=cfg, seed=11)
+        assert engine._qgrad_warmup_steps == 4
+        rng = np.random.default_rng(3)
+        batch = {"input_ids": rng.integers(0, VOCAB, (32, 16), dtype=np.int32)}
+        for _ in range(2):
+            engine.train_batch(batch)
+        err = np.concatenate([np.asarray(x).ravel() for x in
+                              jax.tree_util.tree_leaves(engine._qgrad_error)])
+        assert not err.any()
+        for _ in range(3):  # cross freeze_step
+            engine.train_batch(batch)
+        err = np.concatenate([np.asarray(x).ravel() for x in
+                              jax.tree_util.tree_leaves(engine._qgrad_error)])
+        assert err.any()  # compressed wire engaged, residuals now live
 
 
 class TestZeroOneAdam:
