@@ -423,6 +423,93 @@ def serve_trial_main():
     }))
 
 
+def infinity_trial_main():
+    """Child process: ZeRO-Infinity offload rung — train a model whose fp32
+    training state EXCEEDS the chip's HBM (params + Adam moments + grads),
+    only possible because master params/optimizer state live in pinned host
+    DRAM and stream through HBM per scanned layer / per optimizer sub-group
+    (runtime/param_offload.py; round-4 item 1 'done' criterion). Prints one
+    JSON line of offload metrics."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.topology import reset_topology
+    from deepspeed_tpu.models import llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        _, hbm = chip_spec(getattr(jax.devices()[0], "device_kind", ""))
+        # ~1.15B params: fp32 state = params(4) + m(4) + v(4) + grads(4)
+        # = 16 bytes/param = 18.4 GB > the 16 GB-class chip this runs on
+        # (on bigger chips the claim is still reported, just not exceeded)
+        model_cfg = llama.LlamaConfig(
+            vocab_size=8192, hidden_size=2048, intermediate_size=5504,
+            num_layers=24, num_heads=16, num_kv_heads=8, max_seq_len=512)
+        batch_sz, seq = 2, 512
+    else:
+        hbm = 16e9
+        model_cfg = llama.LlamaConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=344,
+            num_layers=4, num_heads=4, num_kv_heads=2, max_seq_len=128)
+        batch_sz, seq = 2, 64
+    reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(model_cfg, ctx=ctx),
+        config={
+            "train_micro_batch_size_per_device": batch_sz,
+            "gradient_accumulation_steps": 1, "steps_per_print": 0,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "zero_optimization": {
+                "stage": 3, "sub_group_size": 100_000_000,
+                "offload_param": {"device": "cpu"},
+                "offload_optimizer": {"device": "cpu"}},
+            "activation_checkpointing": {"enabled": True},
+            "mesh": {"data": 1, "fsdp": 1}, "seed": 7,
+        }, seed=7)
+    n_params = engine.model_spec.num_params
+    state_bytes = n_params * 16
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return {"input_ids": rng.integers(
+            0, model_cfg.vocab_size, (batch_sz, seq), dtype=np.int32)}
+
+    l0 = float(engine.train_batch(make_batch()))  # compile
+    t0 = time.perf_counter()
+    l1 = float(engine.train_batch(make_batch()))
+    jax.block_until_ready(engine.params)
+    step_s = time.perf_counter() - t0
+    # device footprint of the fwd/bwd program: host args hold the masters
+    dev_arg = host_arg = -1
+    try:
+        if engine._grads_jit is None:
+            engine._grads_jit = engine._build_grads_fn()
+        db = engine._put_gas_batch(make_batch())
+        ma = engine._grads_jit.lower(
+            engine.params, engine.scale_state, jnp.int32(0),
+            engine._train_rng, db).compile().memory_analysis()
+        dev_arg = int(ma.argument_size_in_bytes)
+        host_arg = int(ma.host_argument_size_in_bytes)
+    except Exception:
+        pass
+    print(json.dumps({
+        "infinity_params": n_params,
+        "infinity_state_gb": round(state_bytes / 2**30, 1),
+        "infinity_hbm_gb": round(hbm / 2**30, 1),
+        "infinity_state_exceeds_hbm": bool(state_bytes > hbm),
+        "infinity_step_s": round(step_s, 2),
+        "infinity_loss_finite": bool(np.isfinite(l0) and np.isfinite(l1)),
+        "infinity_device_arg_bytes": dev_arg,
+        "infinity_host_arg_bytes": host_arg,
+    }))
+
+
+def run_infinity_subprocess(timeout: float = 900.0):
+    return _run_flagged_subprocess("BENCH_INFINITY", timeout)
+
+
 def learn_trial_main():
     """Child process: learning-evidence rung — byte-level LM on real text
     (this repo's own source corpus; the environment has no network egress, so
@@ -797,6 +884,9 @@ def main():
     if os.environ.get("BENCH_LEARN"):
         _enable_jit_cache()
         return learn_trial_main()
+    if os.environ.get("BENCH_INFINITY"):
+        _enable_jit_cache()
+        return infinity_trial_main()
     if os.environ.get("BENCH_TRIAL"):
         _enable_jit_cache()
         return trial_main()
@@ -824,6 +914,11 @@ def main():
             result.update(learn)
         else:
             print(f"learning smoke trial failed:\n{errl}", file=sys.stderr)
+        inf, erri = run_infinity_subprocess()
+        if inf is not None:
+            result.update(inf)
+        else:
+            print(f"infinity smoke trial failed:\n{erri}", file=sys.stderr)
         print(json.dumps(result))
         return 0
 
@@ -875,6 +970,14 @@ def main():
                 result.update(learn)
             else:
                 print(f"learning trial failed (headline unaffected):\n{errl}",
+                      file=sys.stderr)
+            # ZeRO-Infinity rung: fp32 training state > HBM, host-resident
+            # masters streamed per layer/sub-group (round-4 item 1)
+            inf, erri = run_infinity_subprocess()
+            if inf is not None:
+                result.update(inf)
+            else:
+                print(f"infinity trial failed (headline unaffected):\n{erri}",
                       file=sys.stderr)
             print(json.dumps(result))
             return 0

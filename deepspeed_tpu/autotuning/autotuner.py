@@ -194,8 +194,11 @@ class Autotuner:
         estimate when the device reports its memory (reference model-profile
         pruning); larger micro batches per stage stop at the first OOM.
         Phase 2: the offload/TP/SP/qgZ dimensions sweep AROUND the phase-1
-        winner (the reference's refinement loop) — each dimension varied
-        independently, best overall wins.
+        winner (the reference's refinement loop), each varied independently.
+        Phase 3: a bounded JOINT sweep over the dimensions that improved —
+        pairwise products + the all-winners combo (capped at 8 trials) — so
+        interactions the independent pass misses (offload x remat, tp x sp)
+        still get tried, without the reference's full cartesian cost.
         """
         import jax
 
@@ -239,19 +242,48 @@ class Autotuner:
                 if self.metric == "throughput" else min(good, key=lambda r: r.step_ms))
 
         # phase 2: refine the winner along the remaining dimensions
-        refinements: list[dict] = []
+        phase1_best = best
+        refinements: list[tuple[str, dict]] = []  # (dimension, addition)
         for dev in offload_devices:
             if dev != "none":
-                refinements.append({**best.overrides, "offload": dev})
+                refinements.append(("offload", {"offload": dev}))
         for tp in tp_degrees:
             if tp > 1 and n_dev % tp == 0:
-                refinements.append({**best.overrides, "tp": tp})
+                refinements.append(("tp", {"tp": tp}))
         for sp in sp_degrees:
             if sp > 1 and n_dev % sp == 0 and seq_len % sp == 0:
-                refinements.append({**best.overrides, "sp": sp})
+                refinements.append(("sp", {"sp": sp}))
         if try_qgz and best.overrides.get("zero_stage", 0) >= 1:
-            refinements.append({**best.overrides, "quantized_gradients": True})
-        for ov in refinements:
+            refinements.append(("qgz", {"quantized_gradients": True}))
+        dim_best: dict[str, tuple[float, dict]] = {}
+        for dim, add in refinements:
+            res = self._run_trial({**best.overrides, **add}, seq_len, vocab)
+            self._record(res)
+            if res.ok and (dim not in dim_best
+                           or res.samples_per_sec > dim_best[dim][0]):
+                dim_best[dim] = (res.samples_per_sec, add)
+
+        # phase 3: bounded JOINT sweep (round-4 weak #8 — independently
+        # varied dimensions never try offload x tp-style interactions, which
+        # the reference's fuller product sweep catches). Combine every
+        # dimension whose best phase-2 value beat the phase-1 winner:
+        # pairwise products plus the all-winners combo, capped.
+        better = [(dim, add) for dim, (sps, add) in dim_best.items()
+                  if sps > phase1_best.samples_per_sec]
+        combos: list[dict] = []
+        for i in range(len(better)):
+            for j in range(i + 1, len(better)):
+                combos.append({**better[i][1], **better[j][1]})
+        if len(better) > 2:
+            allw: dict = {}
+            for _, add in better:
+                allw.update(add)
+            combos.append(allw)
+        tried = {tuple(sorted(r.overrides.items())) for r in self.results}
+        for add in combos[:8]:
+            ov = {**phase1_best.overrides, **add}
+            if tuple(sorted(ov.items())) in tried:
+                continue
             res = self._run_trial(ov, seq_len, vocab)
             self._record(res)
 

@@ -176,14 +176,22 @@ def read_zero_checkpoint(ckpt_dir: str):
                 "(unsupported optimizer checkpoint layout)")
         rank_fp32.append([_np(t).reshape(-1) for t in flats])
         base = osd.get("base_optimizer_state", {})
-        states = base.get("state", base if isinstance(base, dict) else {})
+        if isinstance(base, dict):
+            states = base.get("state", base)
+        elif isinstance(base, (list, tuple)):
+            # some DS wrappers save per-group state LISTS
+            states = dict(enumerate(base))
+        else:
+            states = {}
         ms, vs = [], []
         for g in range(len(flats)):
             st = states.get(g, {}) if isinstance(states, dict) else {}
-            ms.append(_np(st.get("exp_avg",
-                                 np.zeros_like(rank_fp32[-1][g]))).reshape(-1))
-            vs.append(_np(st.get("exp_avg_sq",
-                                 np.zeros_like(rank_fp32[-1][g]))).reshape(-1))
+            if not isinstance(st, dict):
+                st = {}
+            ms.append(_np(st["exp_avg"]).reshape(-1) if "exp_avg" in st
+                      else np.zeros_like(rank_fp32[-1][g]))  # lazy default
+            vs.append(_np(st["exp_avg_sq"]).reshape(-1) if "exp_avg_sq" in st
+                      else np.zeros_like(rank_fp32[-1][g]))  # lazy default
             if "step" in st:
                 step = int(_np(st["step"]).reshape(-1)[0])
         rank_m.append(ms)

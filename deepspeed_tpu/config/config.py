@@ -21,6 +21,24 @@ from typing import Any, ClassVar, Optional, Union
 from deepspeed_tpu.config.base import AUTO, ConfigBase, ConfigError, is_auto
 
 
+# Canonical spellings of the compressed-optimizer family — THE single list
+# (ops/optimizers.py dispatch, the engine's two-phase wire switch, and this
+# config validation all consume it; a spelling added here is recognized
+# everywhere at once).
+ONEBIT_ADAM_NAMES = ("onebit_adam", "onebitadam", "1bit-adam", "1bit_adam")
+ONEBIT_LAMB_NAMES = ("onebit_lamb", "onebitlamb", "1bit-lamb", "1bit_lamb")
+ZERO_ONE_ADAM_NAMES = ("zero_one_adam", "zerooneadam", "01adam", "zoadam")
+
+
+def is_onebit_family(name: str) -> bool:
+    """True for every optimizer whose reference counterpart compresses its
+    gradient wire after warmup (1-bit Adam/LAMB, 0/1 Adam)."""
+    n = name.lower().replace("-", "_")
+    return n in tuple(s.replace("-", "_") for s in
+                      ONEBIT_ADAM_NAMES + ONEBIT_LAMB_NAMES
+                      + ZERO_ONE_ADAM_NAMES)
+
+
 @dataclass
 class OptimizerConfig(ConfigBase):
     type: str = "adamw"  # adamw | adam | sgd | lion | lamb | adagrad
@@ -28,9 +46,7 @@ class OptimizerConfig(ConfigBase):
 
     _SUPPORTED: ClassVar[set] = {
         "adam", "adamw", "sgd", "lion", "lamb", "adagrad", "muon",
-        "onebit_adam", "onebitadam", "1bit-adam",
-        "onebit_lamb", "onebitlamb", "1bit-lamb",
-        "zero_one_adam", "zerooneadam", "01adam", "zoadam",
+        *ONEBIT_ADAM_NAMES, *ONEBIT_LAMB_NAMES, *ZERO_ONE_ADAM_NAMES,
     }
 
     def _validate(self, path: str = "") -> None:

@@ -615,7 +615,8 @@ class RaggedInferenceEngine:
         cfg = self.cfg
         ct = cfg.prefill_tile if self._use_tiles else 0
         k = cfg.fused_chunk
-        nd_full = next(b for b in self._dec_buckets if b >= cfg.max_seqs)
+        nd_full = next(b for b in self._dec_buckets
+                       if b >= min(cfg.max_seqs, cfg.max_tokens_per_step))
         combos: set = set()
         if ct:
             cap0 = max(1, (cfg.max_tokens_per_step - 0) // ct)
@@ -712,14 +713,16 @@ class RaggedInferenceEngine:
             decs.append((seq, k_s))
             if len(decs) >= min(budget, cfg.max_seqs):
                 break
-        # the decode region is all-or-nothing (0 or the max_seqs bucket):
+        # the decode region is all-or-nothing (0 or one fixed bucket):
         # per-count buckets looked cheaper per step but every (k, nd, nt,
         # width) combo is a separate compiled program, and on a remote-
         # compile transport the staggered-arrival shape zoo cost seconds of
         # mid-serve compilation per novel combo — far more than the padded
-        # rows cost (they ride the scratch slot)
+        # rows cost (they ride the scratch slot). Capped by the token
+        # budget so max_seqs > budget configs still honor SplitFuse.
+        nd_cap = min(cfg.max_seqs, budget)
         nd = (0 if not decs
-              else next(b for b in self._dec_buckets if b >= cfg.max_seqs))
+              else next(b for b in self._dec_buckets if b >= nd_cap))
 
         # prefill chunks after the decode region
         chunks: list[tuple[_SeqState, int, int]] = []  # (seq, start, take)

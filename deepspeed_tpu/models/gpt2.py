@@ -142,7 +142,7 @@ def init_cache(cfg: GPT2Config, batch: int, max_len: int,
 
 def _cached_block(cfg: GPT2Config, x, lp, k_cache, v_cache, start_pos,
                   max_len: int):
-    from deepspeed_tpu.ops.attention import xla_attention
+    from deepspeed_tpu.models.paged import append_kv_and_attend
     from deepspeed_tpu.ops.quantizer import dequantize_layer
 
     lp = dequantize_layer(lp, x.dtype)
@@ -151,14 +151,8 @@ def _cached_block(cfg: GPT2Config, x, lp, k_cache, v_cache, start_pos,
     q = (h @ lp["wq"] + lp["bq"]).reshape(b, t, cfg.num_heads, cfg.hd)
     kk = (h @ lp["wk"] + lp["bk"]).reshape(b, t, cfg.num_heads, cfg.hd)
     vv = (h @ lp["wv"] + lp["bv"]).reshape(b, t, cfg.num_heads, cfg.hd)
-    k_cache = lax.dynamic_update_slice(
-        k_cache, kk.astype(k_cache.dtype), (0, start_pos, 0, 0))
-    v_cache = lax.dynamic_update_slice(
-        v_cache, vv.astype(v_cache.dtype), (0, start_pos, 0, 0))
-    q_pos = start_pos + jnp.arange(t)[:, None]
-    k_pos = jnp.arange(max_len)[None, :]
-    bias = jnp.where(k_pos <= q_pos, 0.0, -1e30)[None, None]
-    o = xla_attention(q, k_cache, v_cache, causal=False, bias=bias)
+    o, k_cache, v_cache = append_kv_and_attend(
+        q, kk, vv, k_cache, v_cache, start_pos, max_len)
     x = x + o.reshape(b, t, d) @ lp["wo"] + lp["bo"]
     h = layernorm(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
     h = jax.nn.gelu(h @ lp["w_in"] + lp["b_in"], approximate=True)
@@ -198,36 +192,22 @@ def init_paged_cache(cfg: GPT2Config, num_blocks: int, block_size: int,
 
 def _ragged_block(cfg: GPT2Config, x, lp, kc, vc, positions, slots,
                   block_tables, prefill_tiles=None):
-    from deepspeed_tpu.ops.attention import (
-        paged_attention,
-        ragged_prefill_attention,
+    from deepspeed_tpu.models.paged import (
+        ragged_pool_attention,
+        write_kv_paged,
     )
     from deepspeed_tpu.ops.quantizer import dequantize_layer
 
     lp = dequantize_layer(lp, x.dtype)
     t_tokens, d = x.shape
-    bs = kc.shape[1]
     h = layernorm(x, lp["ln1_g"], lp["ln1_b"], cfg.layer_norm_eps)
     q = (h @ lp["wq"] + lp["bq"]).reshape(t_tokens, cfg.num_heads, cfg.hd)
     kk = (h @ lp["wk"] + lp["bk"]).reshape(t_tokens, cfg.num_heads, cfg.hd)
     vv = (h @ lp["wv"] + lp["bv"]).reshape(t_tokens, cfg.num_heads, cfg.hd)
-    blk = block_tables[slots, positions // bs]
-    off = positions % bs
-    kc = kc.at[blk, off].set(kk.astype(kc.dtype))
-    vc = vc.at[blk, off].set(vv.astype(vc.dtype))
-    if prefill_tiles is None:
-        o = paged_attention(q, kc, vc, slots, positions, block_tables)
-    else:
-        n_dec, ts, tp, tv, ct = prefill_tiles
-        parts = []
-        if n_dec:
-            parts.append(paged_attention(q[:n_dec], kc, vc, slots[:n_dec],
-                                         positions[:n_dec], block_tables))
-        if t_tokens > n_dec:
-            parts.append(ragged_prefill_attention(
-                q[n_dec:], kc, vc, ts, tp, tv, block_tables, ct))
-        o = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
-    x = x + o.astype(x.dtype).reshape(t_tokens, d) @ lp["wo"] + lp["bo"]
+    kc, vc = write_kv_paged(kc, vc, kk, vv, slots, positions, block_tables)
+    o = ragged_pool_attention(q, kc, vc, slots, positions, block_tables,
+                              prefill_tiles).astype(x.dtype)
+    x = x + o.reshape(t_tokens, d) @ lp["wo"] + lp["bo"]
     h = layernorm(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
     h = jax.nn.gelu(h @ lp["w_in"] + lp["b_in"], approximate=True)
     return x + h @ lp["w_out"] + lp["b_out"], kc, vc

@@ -205,6 +205,8 @@ def _cached_layer(cfg: LlamaConfig, ctx: ShardCtx, x, lp, k_cache, v_cache,
                   start_pos, max_len: int):
     """Decode/prefill layer: append new KV at ``start_pos``, attend over the
     cache prefix with absolute-position causal masking."""
+    from deepspeed_tpu.models.paged import append_kv_and_attend
+
     lp = _dq_layer(lp, x.dtype)
     b, t, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
@@ -216,16 +218,8 @@ def _cached_layer(cfg: LlamaConfig, ctx: ShardCtx, x, lp, k_cache, v_cache,
     positions = start_pos + jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
     q, kk = apply_rope(q, kk, positions, cfg.rope_theta)
 
-    k_cache = lax.dynamic_update_slice(k_cache, kk.astype(k_cache.dtype), (0, start_pos, 0, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, vv.astype(v_cache.dtype), (0, start_pos, 0, 0))
-
-    # mask: key visible iff its absolute position <= query's absolute position
-    q_pos = start_pos + jnp.arange(t)[:, None]
-    k_pos = jnp.arange(max_len)[None, :]
-    bias = jnp.where(k_pos <= q_pos, 0.0, -1e30)[None, None]   # [1,1,t,max_len]
-    from deepspeed_tpu.ops.attention import xla_attention
-
-    o = xla_attention(q, k_cache, v_cache, causal=False, bias=bias)
+    o, k_cache, v_cache = append_kv_and_attend(
+        q, kk, vv, k_cache, v_cache, start_pos, max_len)
     x = x + o.reshape(b, t, hq * hd) @ lp["wo"]
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -283,10 +277,14 @@ def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots,
     tile)`` — tokens [0, n_dec) are decodes (per-token kernel), the rest are
     tile-aligned prefill chunks (tiled kernel: one KV-block fetch per tile).
     """
+    from deepspeed_tpu.models.paged import (
+        ragged_pool_attention,
+        write_kv_paged,
+    )
+
     lp = _dq_layer(lp, x.dtype)
     t_tokens, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
-    bs = kc.shape[1]
 
     h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
     q = (h @ lp["wq"]).reshape(t_tokens, hq, hd)
@@ -295,32 +293,9 @@ def _ragged_layer(cfg: LlamaConfig, x, lp, kc, vc, positions, slots,
     q, kk = apply_rope(q[None], kk[None], positions[None], cfg.rope_theta)
     q, kk = q[0], kk[0]
 
-    # scatter each token's KV into (block, offset) of its sequence
-    blk = block_tables[slots, positions // bs]  # [T]
-    off = positions % bs
-    kc = kc.at[blk, off].set(kk.astype(kc.dtype))
-    vc = vc.at[blk, off].set(vv.astype(vc.dtype))
-
-    # paged attention over the blocked pool: Pallas block-table kernels on
-    # TPU, padded-gather XLA fallback (ops/attention)
-    from deepspeed_tpu.ops.attention import (
-        paged_attention,
-        ragged_prefill_attention,
-    )
-
-    if prefill_tiles is None:
-        o = paged_attention(q, kc, vc, slots, positions, block_tables)
-    else:
-        n_dec, ts, tp, tv, ct = prefill_tiles
-        parts = []
-        if n_dec:
-            parts.append(paged_attention(q[:n_dec], kc, vc, slots[:n_dec],
-                                         positions[:n_dec], block_tables))
-        if t_tokens > n_dec:
-            parts.append(ragged_prefill_attention(
-                q[n_dec:], kc, vc, ts, tp, tv, block_tables, ct))
-        o = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
-    o = o.astype(x.dtype)
+    kc, vc = write_kv_paged(kc, vc, kk, vv, slots, positions, block_tables)
+    o = ragged_pool_attention(q, kc, vc, slots, positions, block_tables,
+                              prefill_tiles).astype(x.dtype)
     x = x + o.reshape(t_tokens, hq * hd) @ lp["wo"]
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -206,8 +206,8 @@ def init_cache(cfg: MixtralConfig, batch: int, max_len: int,
 
 def _cached_layer(cfg: MixtralConfig, x, lp, k_cache, v_cache, start_pos,
                   max_len: int):
+    from deepspeed_tpu.models.paged import append_kv_and_attend
     from deepspeed_tpu.ops.quantizer import dequantize_layer
-    from deepspeed_tpu.ops.attention import xla_attention
 
     lp = dequantize_layer(lp, x.dtype)
     b, t, d = x.shape
@@ -219,14 +219,8 @@ def _cached_layer(cfg: MixtralConfig, x, lp, k_cache, v_cache, start_pos,
     vv = (h @ lp["wv"]).reshape(b, t, hkv, hd)
     positions = start_pos + jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
     q, kk = apply_rope(q, kk, positions, cfg.rope_theta)
-    k_cache = lax.dynamic_update_slice(
-        k_cache, kk.astype(k_cache.dtype), (0, start_pos, 0, 0))
-    v_cache = lax.dynamic_update_slice(
-        v_cache, vv.astype(v_cache.dtype), (0, start_pos, 0, 0))
-    q_pos = start_pos + jnp.arange(t)[:, None]
-    k_pos = jnp.arange(max_len)[None, :]
-    bias = jnp.where(k_pos <= q_pos, 0.0, -1e30)[None, None]
-    o = xla_attention(q, k_cache, v_cache, causal=False, bias=bias)
+    o, k_cache, v_cache = append_kv_and_attend(
+        q, kk, vv, k_cache, v_cache, start_pos, max_len)
     x = x + o.reshape(b, t, hq * hd) @ lp["wo"]
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -272,16 +266,15 @@ def _ragged_layer(cfg: MixtralConfig, x, lp, kc, vc, positions, slots,
     (decode tokens route through the SAME per-token top-k machinery as
     prefill-chunk tokens — MoE over a paged cache is a routing problem only
     in the FFN, which is position-free)."""
-    from deepspeed_tpu.ops.attention import (
-        paged_attention,
-        ragged_prefill_attention,
+    from deepspeed_tpu.models.paged import (
+        ragged_pool_attention,
+        write_kv_paged,
     )
     from deepspeed_tpu.ops.quantizer import dequantize_layer
 
     lp = dequantize_layer(lp, x.dtype)
     t_tokens, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
-    bs = kc.shape[1]
 
     h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
     q = (h @ lp["wq"]).reshape(t_tokens, hq, hd)
@@ -290,24 +283,10 @@ def _ragged_layer(cfg: MixtralConfig, x, lp, kc, vc, positions, slots,
     q, kk = apply_rope(q[None], kk[None], positions[None], cfg.rope_theta)
     q, kk = q[0], kk[0]
 
-    blk = block_tables[slots, positions // bs]
-    off = positions % bs
-    kc = kc.at[blk, off].set(kk.astype(kc.dtype))
-    vc = vc.at[blk, off].set(vv.astype(vc.dtype))
-
-    if prefill_tiles is None:
-        o = paged_attention(q, kc, vc, slots, positions, block_tables)
-    else:
-        n_dec, ts, tp, tv, ct = prefill_tiles
-        parts = []
-        if n_dec:
-            parts.append(paged_attention(q[:n_dec], kc, vc, slots[:n_dec],
-                                         positions[:n_dec], block_tables))
-        if t_tokens > n_dec:
-            parts.append(ragged_prefill_attention(
-                q[n_dec:], kc, vc, ts, tp, tv, block_tables, ct))
-        o = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
-    x = x + o.astype(x.dtype).reshape(t_tokens, hq * hd) @ lp["wo"]
+    kc, vc = write_kv_paged(kc, vc, kk, vv, slots, positions, block_tables)
+    o = ragged_pool_attention(q, kc, vc, slots, positions, block_tables,
+                              prefill_tiles).astype(x.dtype)
+    x = x + o.reshape(t_tokens, hq * hd) @ lp["wo"]
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     x = x + _moe_infer(h, lp["router"], lp["w_gate"], lp["w_up"],

@@ -1,0 +1,68 @@
+"""Shared KV-cache plumbing for the model families' inference paths.
+
+One home for the logic every family (llama, gpt2, mixtral) used to carry
+verbatim: the paged-pool KV scatter, the decode/tiled-prefill attention
+split over the block pool (reference ``inference/v2/ragged_ops`` layout),
+and the dense-cache append+attend used by the v1-style engines. A fix to
+the paged contract (e.g. the ``_table_view`` width slicing) lands HERE once
+instead of three times.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def write_kv_paged(kc, vc, kk, vv, slots, positions, block_tables):
+    """Scatter each ragged token's new KV into (block, offset) of its
+    sequence's pool blocks. ``kk``/``vv``: [T, Hkv, D]."""
+    bs = kc.shape[1]
+    blk = block_tables[slots, positions // bs]  # [T]
+    off = positions % bs
+    kc = kc.at[blk, off].set(kk.astype(kc.dtype))
+    vc = vc.at[blk, off].set(vv.astype(vc.dtype))
+    return kc, vc
+
+
+def ragged_pool_attention(q, kc, vc, slots, positions, block_tables,
+                          prefill_tiles=None):
+    """Attention over the blocked pool for a flat ragged token batch:
+    per-token paged kernel for the decode region, the tiled SplitFuse
+    kernel for tile-aligned prefill chunks (``prefill_tiles`` =
+    ``(n_dec, tile_slot, tile_pos0, tile_valid, tile)``)."""
+    from deepspeed_tpu.ops.attention import (
+        paged_attention,
+        ragged_prefill_attention,
+    )
+
+    t_tokens = q.shape[0]
+    if prefill_tiles is None:
+        return paged_attention(q, kc, vc, slots, positions, block_tables)
+    n_dec, ts, tp, tv, ct = prefill_tiles
+    parts = []
+    if n_dec:
+        parts.append(paged_attention(q[:n_dec], kc, vc, slots[:n_dec],
+                                     positions[:n_dec], block_tables))
+    if t_tokens > n_dec:
+        parts.append(ragged_prefill_attention(
+            q[n_dec:], kc, vc, ts, tp, tv, block_tables, ct))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
+def append_kv_and_attend(q, kk, vv, k_cache, v_cache, start_pos, max_len):
+    """Dense-cache decode/prefill step: write new KV at ``start_pos``,
+    attend over the cache prefix under absolute-position causal masking.
+    ``q``/``kk``/``vv``: [B, T, H*, D]; returns (o, k_cache, v_cache)."""
+    from deepspeed_tpu.ops.attention import xla_attention
+
+    t = q.shape[1]
+    k_cache = lax.dynamic_update_slice(
+        k_cache, kk.astype(k_cache.dtype), (0, start_pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, vv.astype(v_cache.dtype), (0, start_pos, 0, 0))
+    q_pos = start_pos + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(max_len)[None, :]
+    bias = jnp.where(k_pos <= q_pos, 0.0, -1e30)[None, None]
+    o = xla_attention(q, k_cache, v_cache, causal=False, bias=bias)
+    return o, k_cache, v_cache

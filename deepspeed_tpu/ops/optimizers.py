@@ -22,6 +22,14 @@ import optax
 from deepspeed_tpu.config.config import OptimizerConfig
 
 
+from deepspeed_tpu.config.config import (  # noqa: F401 (re-export)
+    ONEBIT_ADAM_NAMES,
+    ONEBIT_LAMB_NAMES,
+    ZERO_ONE_ADAM_NAMES,
+    is_onebit_family,
+)
+
+
 class ZeroOneAdamState(NamedTuple):
     """0/1 Adam state: ``vcount`` counts variance refreshes actually applied
     (the sparse schedule makes it lag ``count``), used for b2 bias correction."""
@@ -79,7 +87,8 @@ def build_optimizer(
         if muon is None:
             raise NotImplementedError("optax.contrib.muon unavailable in this optax build")
         return muon(lr)
-    if t in ("onebit_adam", "onebitadam", "1bit-adam"):
+    if t.replace("-", "_") in tuple(
+            s.replace("-", "_") for s in ONEBIT_ADAM_NAMES):
         tx = scale_by_onebit_adam(
             warmup_steps=int(p.get("freeze_step", p.get("warmup_steps", 100))),
             **_adam_args(p),
@@ -89,7 +98,8 @@ def build_optimizer(
             parts.append(optax.add_decayed_weights(wd))
         parts.append(optax.scale_by_learning_rate(lr))
         return optax.chain(*parts)
-    if t in ("onebit_lamb", "onebitlamb", "1bit-lamb"):
+    if t.replace("-", "_") in tuple(
+            s.replace("-", "_") for s in ONEBIT_LAMB_NAMES):
         tx = scale_by_onebit_lamb(
             warmup_steps=int(p.get("freeze_step", p.get("warmup_steps", 100))),
             max_coeff=float(p.get("max_coeff", 10.0)),
@@ -102,7 +112,7 @@ def build_optimizer(
             parts.append(optax.add_decayed_weights(wd))
         parts.append(optax.scale_by_learning_rate(lr))
         return optax.chain(*parts)
-    if t in ("zero_one_adam", "zerooneadam", "01adam", "zoadam"):
+    if t in ZERO_ONE_ADAM_NAMES:
         tx = scale_by_zero_one_adam(
             var_freeze_step=int(p.get("var_freeze_step", 100)),
             var_update_scaler=int(p.get("var_update_scaler", 16)),

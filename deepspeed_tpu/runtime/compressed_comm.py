@@ -30,7 +30,7 @@ from deepspeed_tpu.comm.quantized_collectives import (
 from deepspeed_tpu.comm.topology import batch_partition_axes
 
 
-def compressed_grad_allreduce(grads, error, mesh, bits: int = 1,
+def compressed_grad_allreduce(grads, error, mesh, bits: int = 8,
                               block: int = 256):
     """Error-feedback compressed mean-allreduce of a gradient pytree.
 
@@ -39,7 +39,9 @@ def compressed_grad_allreduce(grads, error, mesh, bits: int = 1,
     Returns ``(reduced grads, new error)``. Mirrors
     ``NcclBackend.compressed_allreduce`` semantics: the quantization error
     re-enters the next step's gradients, so the compression bias vanishes
-    over steps while the wire carries ``bits``-wide payloads.
+    over steps while the wire carries ``bits``-wide payloads. The default
+    stays int8 (this function's historical numeric behavior); pass
+    ``bits=1`` for the 1-bit-Adam sign wire.
     """
     if bits not in SUPPORTED_WIRE_BITS:
         raise NotImplementedError(

@@ -67,6 +67,42 @@ def apply_seqlen_curriculum(batch: dict, seq_len: int) -> dict:
     return out
 
 
+@dataclass
+class MetricCurriculumSampler:
+    """Metric-driven data sampling (reference ``runtime/data_pipeline/
+    data_sampling``: offline per-sample difficulty metrics + a curriculum
+    that admits progressively harder samples). ``metrics`` is one difficulty
+    value per sample (e.g. loss, vocab rarity — whatever the analyzer
+    computed); at step t only samples with metric <= the scheduler's
+    difficulty are drawn. Difficulty units are PERCENTILES of the metric
+    distribution (min_difficulty=30 -> the easiest 30% admitted), matching
+    the reference's index-cluster semantics without its on-disk index."""
+
+    metrics: "np.ndarray"
+    scheduler: CurriculumScheduler
+    seed: int = 0
+
+    def __post_init__(self):
+        self.metrics = np.asarray(self.metrics, np.float64)
+        if self.metrics.ndim != 1 or not len(self.metrics):
+            raise ConfigError("metrics must be a non-empty 1-D array")
+        self._order = np.argsort(self.metrics, kind="stable")
+        self._rng = np.random.default_rng(self.seed)
+
+    def admitted(self, global_step: int) -> np.ndarray:
+        """Indices admitted at this step (easiest difficulty-% of samples)."""
+        pct = min(100, max(1, self.scheduler.get_difficulty(global_step)))
+        n = max(1, int(round(len(self.metrics) * pct / 100.0)))
+        return self._order[:n]
+
+    def sample(self, global_step: int, batch_size: int) -> np.ndarray:
+        """Draw a batch (with replacement when the admitted pool is small —
+        early curriculum pools can be tiny)."""
+        pool = self.admitted(global_step)
+        return self._rng.choice(pool, size=batch_size,
+                                replace=len(pool) < batch_size)
+
+
 def dynamic_batches(lengths, max_tokens: int, bucket_step: int = 64,
                     rng: np.random.Generator | None = None,
                     min_batch: int = 1, rows_multiple_of: int = 1):
@@ -98,8 +134,11 @@ def dynamic_batches(lengths, max_tokens: int, bucket_step: int = 64,
         idx = buckets[padded]
         if rng is not None:
             idx = list(rng.permutation(idx))
-        rows = max(min_batch, max_tokens // padded, m)
-        rows = -(-rows // m) * m  # round UP: keeps both floors
+        # constraint precedence: multiple-of-m is hard (dp divisibility),
+        # the token budget is a ceiling (floor to the multiple), min_batch
+        # is best-effort when the three conflict
+        rows = max(min_batch, max_tokens // padded)
+        rows = max(m, (rows // m) * m)
         for s in range(0, len(idx), rows):
             chunk = list(idx[s:s + rows])
             short = (-len(chunk)) % m

@@ -484,10 +484,9 @@ class Engine:
         # compressed program
         self._qgrad_warmup_steps = 0
         self._warm_batch_jit = None
-        if self._qgrad and config.optimizer.type.lower().replace("-", "_") in (
-                "onebit_adam", "onebitadam", "1bit_adam", "onebit_lamb",
-                "onebitlamb", "1bit_lamb", "zero_one_adam", "zerooneadam",
-                "01adam", "zoadam"):
+        from deepspeed_tpu.ops.optimizers import is_onebit_family
+
+        if self._qgrad and is_onebit_family(config.optimizer.type):
             op = dict(config.optimizer.params)
             self._qgrad_warmup_steps = int(
                 op.get("freeze_step", op.get("warmup_steps",

@@ -94,3 +94,38 @@ assert any(ov.get("quantized_gradients") for ov in tried)
 assert best["zero_stage"] == 1 and best["micro_batch"] == 2
 print("REFINE_OK")
 """, "REFINE_OK")
+
+
+def test_joint_sweep_finds_interaction():
+    """Phase 3 (round-4 weak #8): dimensions that each improve are ALSO
+    tried together, and an interaction win (combo > either alone) is
+    found. Trials are synthetic (monkeypatched) so the interaction is
+    deterministic."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner, TrialResult
+
+    speeds = {
+        (): 1.0,                      # phase-1 winner baseline
+        ("offload",): 2.0,            # each dim improves alone...
+        ("tp",): 3.0,
+        ("offload", "tp"): 10.0,      # ...and MORE together
+    }
+
+    def fake_trial(self, overrides, seq_len, vocab):
+        key = tuple(sorted(
+            k for k in ("offload", "tp") if overrides.get(k) not in
+            (None, "none", 1)))
+        sps = speeds.get(key, 0.5)
+        return TrialResult(overrides=dict(overrides),
+                           samples_per_sec=sps, step_ms=1000.0 / sps)
+
+    tuner = Autotuner(model_builder=None, base_config={}, steps_per_trial=1)
+    tuner._run_trial = fake_trial.__get__(tuner)
+    best = tuner.tune(micro_batch_sizes=[2], zero_stages=[1],
+                      seq_len=16, vocab=VOCAB,
+                      offload_devices=("none", "cpu"), tp_degrees=(1, 2),
+                      memory_bytes=0)
+    assert best.get("offload") == "cpu" and best.get("tp") == 2, best
+    combos = [r.overrides for r in tuner.results
+              if r.overrides.get("offload") == "cpu"
+              and r.overrides.get("tp") == 2]
+    assert combos, "joint combo never tried"

@@ -135,13 +135,23 @@ def test_gke_runner_manifest():
                   image="gcr.io/p/i:tag", tpu_topology="4x8",
                   accelerator="tpu-v5p-slice", extra_env={"A": "b"})
     m = r.get_manifest()
-    assert "kind: JobSet" in m and "name: j1" in m
+    # scalars are JSON-quoted (valid YAML for any value, incl. quotes)
+    assert "kind: JobSet" in m and 'name: "j1"' in m
     assert "parallelism: 8" in m and "completions: 8" in m
-    assert "gke-tpu-topology: 4x8" in m
-    assert "gke-tpu-accelerator: tpu-v5p-slice" in m
+    assert 'gke-tpu-topology: "4x8"' in m
+    assert 'gke-tpu-accelerator: "tpu-v5p-slice"' in m
     assert "python train.py --x" in m
-    assert "name: A" in m
+    assert 'name: "A"' in m and 'value: "b"' in m
+    # chip count derived from topology: 4x8 = 32 chips over 8 nodes
+    assert 'google.com/tpu: "4"' in m
     assert r.get_cmd() == [["kubectl", "apply", "-f", "-"]]
+    # a value with quotes/newlines still yields parseable YAML scalars
+    r2 = GKERunner("t.py", [], job_name="x", num_nodes=2, image="i",
+                   tpu_topology="2x4",
+                   extra_env={"B": 'he said "hi"\nline2'})
+    m2 = r2.get_manifest()
+    assert '"he said \\"hi\\"\\nline2"' in m2
+    assert 'google.com/tpu: "4"' in m2  # 8 chips / 2 nodes
 
 
 def test_cli_builds_slurm_runner(tmp_path):

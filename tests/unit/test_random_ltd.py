@@ -129,3 +129,40 @@ class TestDynamicBatching:
         assert out["attention_mask"][0].sum() == 5
         assert out["attention_mask"][1].sum() == 9
         np.testing.assert_array_equal(out["input_ids"][0, :5], np.arange(5))
+
+
+class TestMetricCurriculumSampler:
+    def test_easy_first_then_everything(self):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            CurriculumScheduler,
+            MetricCurriculumSampler,
+        )
+
+        rng = np.random.default_rng(0)
+        metrics = rng.normal(size=200)
+        sched = CurriculumScheduler(min_difficulty=20, max_difficulty=100,
+                                    schedule_type="fixed_linear",
+                                    total_curriculum_step=100,
+                                    difficulty_step=10)
+        s = MetricCurriculumSampler(metrics, sched, seed=1)
+        early = s.admitted(0)
+        assert len(early) == 40  # easiest 20%
+        thr = np.sort(metrics)[len(early) - 1]
+        assert metrics[early].max() <= thr + 1e-12
+        assert len(s.admitted(100)) == 200  # full set at the end
+        batch = s.sample(0, 16)
+        assert set(batch) <= set(early)
+
+    def test_tiny_pool_samples_with_replacement(self):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            CurriculumScheduler,
+            MetricCurriculumSampler,
+        )
+
+        sched = CurriculumScheduler(min_difficulty=1, max_difficulty=100,
+                                    schedule_type="fixed_linear",
+                                    total_curriculum_step=10,
+                                    difficulty_step=1)
+        s = MetricCurriculumSampler(np.arange(10.0), sched, seed=2)
+        batch = s.sample(0, 8)
+        assert len(batch) == 8  # pool of 1, drawn with replacement
