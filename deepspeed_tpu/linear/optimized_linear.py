@@ -28,7 +28,51 @@ class QuantizationConfig:
 class LoRAConfig:
     lora_r: int = 64
     lora_alpha: float = 16.0
-    base_weight_sharding: int = 1  # parity field; sharding comes from the planner
+    # reference LoRAOptimizedLinear.base_weight_sharding: the frozen base
+    # weight is stored sharded across the world and gathered on use. Here
+    # the sharding is applied by passing the base through
+    # shard_base_weight(mesh) — which raises when the mesh cannot honor it —
+    # rather than by this integer (the mesh axis is the shard group).
+    base_weight_sharding: int = 1
+
+
+def shard_base_weight(base, mesh, axis: str = "fsdp"):
+    """Store a (quantized or dense) base weight SHARDED over a mesh axis —
+    the reference's ``base_weight_sharding`` memory story
+    (``linear/optimized_linear.py:76``: each rank persists 1/world of the
+    frozen base; forward gathers on use). TPU-native form: the storage
+    sharding is declared on the arrays (QuantizedTensor leaves shard on
+    their leading/blocked dim) and GSPMD inserts the gather where the
+    dequant-matmul consumes them — between uses only the local shard is
+    resident. Raises when the mesh cannot honor the request (no silent
+    replicated fallback: the caller asked for the 1/world memory story)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+        raise ValueError(
+            f"shard_base_weight: mesh has no {axis!r} axis > 1 — the base "
+            "weight would silently stay fully replicated on every device")
+    n = mesh.shape[axis]
+
+    def place(x):
+        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] % n == 0:
+            spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
+        else:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                "shard_base_weight: leading dim %s not divisible by %s=%d; "
+                "this leaf stays replicated",
+                getattr(x, "shape", "?"), axis, n)
+            spec = PartitionSpec(*([None] * getattr(x, "ndim", 0)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    if isinstance(base, QuantizedTensor):
+        return QuantizedTensor(values=place(base.values),
+                               scales=place(base.scales),
+                               shape=base.shape, bits=base.bits,
+                               block=base.block)
+    return place(base)
 
 
 def QuantizedParameter(w: jnp.ndarray, cfg: QuantizationConfig = QuantizationConfig()

@@ -188,9 +188,13 @@ class Engine:
             if self._param_offload == "nvme":
                 raise ConfigError(
                     "zero_optimization.offload_param.device='nvme' is not "
-                    "implemented (params would need per-layer NVMe fetch "
-                    "inside the compiled step); use device='cpu' — the host-"
-                    "DRAM tier streams the layer stack through HBM per layer")
+                    "implemented: per-layer NVMe fetch inside the compiled "
+                    "step needs host callbacks (jax io_callback), which this "
+                    "PJRT transport does not support (probed: 'axon_pjrt "
+                    "does not support host send/recv callbacks'). Use "
+                    "device='cpu' — the host-DRAM tier streams the layer "
+                    "stack through HBM per layer and covers models whose "
+                    "fp32 state exceeds HBM (the bench infinity rung)")
             if self.zero_stage != 3:
                 raise ConfigError(
                     "offload_param streams the stage-3 scanned layer stack; "
