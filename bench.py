@@ -616,15 +616,25 @@ def run_serve_subprocess(timeout: float = 900.0):
 
 def probe_device():
     """Probe backend/device kind in a throwaway subprocess so the parent never
-    holds the TPU (a held chip would make every trial subprocess fail to init)."""
+    holds the TPU (a held chip would make every trial subprocess fail to init).
+
+    A HUNG probe (observed: the axon tunnel relay dying outright — port 8083
+    gone, jax.devices() blocking forever) must fail loudly with a diagnosis,
+    not crash the bench with a raw TimeoutExpired."""
     code = (
         "import jax, json;"
         "d = jax.devices()[0];"
         "print(json.dumps({'backend': jax.default_backend(),"
         " 'kind': getattr(d, 'device_kind', '')}))"
     )
-    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                          text=True, timeout=300)
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            "bench: device probe hung for 300 s — the accelerator transport "
+            "is wedged or its relay died (check that something listens on "
+            "127.0.0.1:8083). No benchable device; aborting.")
     if proc.returncode != 0:
         raise RuntimeError("device probe failed:\n" + proc.stderr[-2000:])
     for line in reversed(proc.stdout.strip().splitlines()):
