@@ -161,6 +161,12 @@ def trial_main():
             "tiled_logits": True,
             "tile_size": int(e.get("BENCH_TILE", "2048")),
         }
+    # every bench run doubles as a telemetry fixture: step spans, HBM
+    # watermarks, and the final registry snapshot land in a JSONL next to
+    # the JSON result line (docs/OBSERVABILITY.md)
+    tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_telemetry.jsonl"))
+    config["telemetry"] = {"enabled": True, "jsonl_path": tel_path}
     engine, _, _, _ = deepspeed_tpu.initialize(
         # remat/policy inherit from the config via ShardCtx (single source)
         model=lambda ctx: llama.build(model_cfg, ctx=ctx),
@@ -188,6 +194,9 @@ def trial_main():
     if jax.default_backend() != "tpu":
         peak = 1e12  # nominal denominator for CPU smoke runs
     mfu = tokens_per_s * flops_per_token / peak
+    from deepspeed_tpu import telemetry
+
+    telemetry.TELEMETRY.close()  # appends the final registry snapshot record
     print(json.dumps({
         "metric": "llama_train_mfu_single_chip",
         "zero_stage": stage,
@@ -201,6 +210,7 @@ def trial_main():
         "final_loss": round(loss, 4),
         "device": str(jax.devices()[0].device_kind),
         "backend": jax.default_backend(),
+        "telemetry_jsonl": tel_path,
     }))
 
 
@@ -252,6 +262,15 @@ def serve_trial_main():
         prompt_lens = [16, 32, 64]
         max_seqs, budget, block, tile, ahead = 4, 64, 16, 16, 8
         fused, depth = 4, 2
+
+    # request-lifecycle spans (queue wait, TTFT, per-token decode latency,
+    # preemptions) for every ragged request in this trial
+    from deepspeed_tpu import telemetry
+
+    tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_serve_telemetry.jsonl"))
+    telemetry.configure(enabled=True, jsonl_path=tel_path)
 
     rng = np.random.default_rng(0)
     lens = [int(prompt_lens[i % len(prompt_lens)]) for i in range(n_req)]
@@ -394,6 +413,7 @@ def serve_trial_main():
     den_lat = list(run_dense_staggered().values())
     rag_mean = sum(rag_lat) / len(rag_lat)
     den_mean = sum(den_lat) / len(den_lat)
+    telemetry.TELEMETRY.close()
     print(json.dumps({
         "ragged_tokens_per_s": round(useful_tokens / ragged_s, 1),
         "dense_tokens_per_s": round(useful_tokens / dense_s, 1),
@@ -420,6 +440,7 @@ def serve_trial_main():
         "serve_reqs": n_req,
         "serve_useful_tokens": useful_tokens,
         "serve_max_new": max_new,
+        "telemetry_jsonl": tel_path,
     }))
 
 

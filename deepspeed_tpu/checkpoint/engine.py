@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import time
 from typing import Any
 
 import numpy as np
@@ -32,18 +33,34 @@ class CheckpointEngine:
     for reading/writing the old layout."""
 
     def save(self, state: dict[str, dict[str, np.ndarray]], ckpt_dir: str) -> None:
+        from deepspeed_tpu.telemetry import TELEMETRY
+
+        t0 = time.perf_counter() if TELEMETRY.enabled else 0.0
+        total_bytes = 0
         for name, arrays in state.items():
             if name == "manifest":
                 ser.save_json(os.path.join(ckpt_dir, "manifest.json"), arrays)
             else:
                 ser.save_arrays(os.path.join(ckpt_dir, f"{name}.npz"), arrays)
+                total_bytes += sum(
+                    int(np.asarray(a).nbytes) for a in arrays.values())
+        if TELEMETRY.enabled:
+            TELEMETRY.emit_span("checkpoint/engine_save",
+                                time.perf_counter() - t0,
+                                dir=ckpt_dir, bytes=total_bytes)
 
     def load(self, ckpt_dir: str, names: list[str]) -> dict[str, Any]:
+        from deepspeed_tpu.telemetry import TELEMETRY
+
+        t0 = time.perf_counter() if TELEMETRY.enabled else 0.0
         out = {"manifest": ser.load_json(os.path.join(ckpt_dir, "manifest.json"))}
         for name in names:
             path = os.path.join(ckpt_dir, f"{name}.npz")
             if os.path.exists(path):
                 out[name] = ser.load_arrays(path)
+        if TELEMETRY.enabled:
+            TELEMETRY.emit_span("checkpoint/engine_load",
+                                time.perf_counter() - t0, dir=ckpt_dir)
         return out
 
 

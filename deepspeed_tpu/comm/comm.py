@@ -255,4 +255,12 @@ def configure(comms_config) -> None:
 
 
 def log_summary(show_straggler: bool = False) -> str:
-    return COMMS_LOGGER.log_summary(show_straggler=show_straggler)
+    text = COMMS_LOGGER.log_summary(show_straggler=show_straggler)
+    # the rendered summary also lands in the telemetry event log, so a run's
+    # JSONL record carries the same table the console printed (the per-op
+    # counters are already live in the registry via the ledger bridge)
+    from deepspeed_tpu.telemetry import TELEMETRY
+
+    if TELEMETRY.enabled:
+        TELEMETRY.event("comm/summary", text=text)
+    return text

@@ -458,6 +458,43 @@ class CommsLoggerConfig(ConfigBase):
     prof_all: bool = True
     prof_ops: list = field(default_factory=list)
     debug: bool = False
+    # straggler analysis: warn when a collective's max/min latency across
+    # processes exceeds this ratio
+    straggler_warn_ratio: float = 2.0
+
+    def _validate(self, path: str = "") -> None:
+        if self.straggler_warn_ratio < 1.0:
+            raise ConfigError(
+                f"{path}straggler_warn_ratio: must be >= 1.0, got "
+                f"{self.straggler_warn_ratio}")
+
+
+@dataclass
+class TelemetryConfig(ConfigBase):
+    """Structured telemetry bus (``deepspeed_tpu/telemetry/``, see
+    docs/OBSERVABILITY.md): metrics registry + span/event log with pluggable
+    exporters. Disabled, every emit path is a single flag check."""
+
+    enabled: bool = False
+    # JSONL event-log sink (step spans, request spans, HBM watermarks, final
+    # registry snapshot); None/"" disables the file sink
+    jsonl_path: Optional[str] = None
+    # {enabled, host, port}: Prometheus text exposition on a stdlib HTTP
+    # server (port 0 = ephemeral)
+    prometheus: dict = field(default_factory=dict)
+    # sample accelerator.memory_stats() into hbm_* gauges every step
+    hbm_watermarks: bool = True
+    # mirror scalar telemetry events into the monitor writers (TensorBoard/
+    # CSV/W&B/Comet become one sink among the exporters)
+    monitor_sink: bool = False
+    # flush the file sink every N emitted records
+    flush_interval_events: int = 100
+
+    def _validate(self, path: str = "") -> None:
+        if self.flush_interval_events < 1:
+            raise ConfigError(
+                f"{path}flush_interval_events: must be >= 1, got "
+                f"{self.flush_interval_events}")
 
 
 @dataclass
@@ -599,6 +636,7 @@ class Config(ConfigBase):
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)

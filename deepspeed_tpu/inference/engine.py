@@ -14,6 +14,7 @@ Ragged/continuous batching (v2 FastGen analog) lives in
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
@@ -25,6 +26,7 @@ from deepspeed_tpu.comm.topology import get_topology, topology_initialized
 from deepspeed_tpu.config.config import MeshConfig
 from deepspeed_tpu.models.api import ModelSpec, ShardCtx
 from deepspeed_tpu.parallel.partition import plan_sharding
+from deepspeed_tpu.telemetry import get_telemetry
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -214,7 +216,10 @@ class InferenceEngine:
         use_penalty = repetition_penalty != 1.0
         has_tk, has_tp = top_k > 0, top_p < 1.0
         key = (b, t, max_new_tokens, sample, use_penalty, has_tk, has_tp)
-        if key not in self._gen_cache:
+        telemetry = get_telemetry()
+        t0 = time.perf_counter() if telemetry.enabled else 0.0
+        compiled = key in self._gen_cache
+        if not compiled:
             self._gen_cache[key] = self._build_generate(
                 b, t, max_new_tokens, sample, use_penalty, has_tk, has_tp)
         toks = self._gen_cache[key](
@@ -226,7 +231,19 @@ class InferenceEngine:
             jnp.float32(top_p),
             jnp.float32(repetition_penalty),
         )
-        return np.concatenate([input_ids, np.asarray(toks)], axis=1)
+        toks = np.asarray(toks)
+        if telemetry.enabled:
+            # the whole prefill+decode program is one dispatch: TTFT/per-token
+            # breakdown belongs to the ragged engine; here the span carries
+            # batch shape + whether this call paid the compile
+            telemetry.emit_span(
+                "inference/generate", time.perf_counter() - t0,
+                batch=b, prompt_tokens=t, new_tokens=max_new_tokens,
+                cached_program=compiled)
+            telemetry.counter(
+                "inference_tokens_generated_total", "tokens generated").inc(
+                    b * max_new_tokens)
+        return np.concatenate([input_ids, toks], axis=1)
 
     def forward(self, input_ids):
         """Plain logits forward (reference ``engine.forward:557``); jitted —

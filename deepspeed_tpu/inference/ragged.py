@@ -25,6 +25,7 @@ drop-in optimization point.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -33,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.models.api import ModelSpec, ShardCtx
+from deepspeed_tpu.telemetry import get_telemetry
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -136,6 +138,14 @@ class _SeqState:
     # fused-pipeline bookkeeping: chunks dispatched but not yet reconciled
     # that reference this sequence (release deferred until it drains)
     refs: int = 0
+    # request-lifecycle telemetry (perf_counter stamps; 0.0 = not recorded):
+    # enqueue -> admit is queue wait, enqueue -> first token is TTFT
+    t_enqueue: float = 0.0
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    t_last_token: float = 0.0
+    # decode steps where this sequence stalled on KV-pool pressure
+    preemptions: int = 0
 
     def token_at(self, p: int) -> int:
         if p < len(self.prompt):
@@ -256,6 +266,11 @@ class RaggedInferenceEngine:
         self.tokens_padded = 0
         self.dispatch_count = 0
         self.tokens_emitted = 0
+        self.preemptions = 0
+        # structured telemetry bus: request spans (queue wait / TTFT /
+        # per-token decode latency / preemptions) + KV-occupancy gauges; every
+        # emit is behind the singleton's enabled flag
+        self.telemetry = get_telemetry()
         log_dist(
             f"RaggedInferenceEngine: model={self.spec.name} "
             f"budget={self.cfg.max_tokens_per_step} max_seqs={self.cfg.max_seqs} "
@@ -295,7 +310,11 @@ class RaggedInferenceEngine:
             eos_token_id=eos_token_id if eos_token_id is not None else self.eos_token_id,
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p),
+            t_enqueue=time.perf_counter() if self.telemetry.enabled else 0.0,
         ))
+        if self.telemetry.enabled:
+            self.telemetry.counter(
+                "inference_requests_queued_total", "requests accepted").inc()
 
     @property
     def has_work(self) -> bool:
@@ -332,6 +351,12 @@ class RaggedInferenceEngine:
         self.block_tables[seq.slot, start:start + len(new)] = new
         return True
 
+    @staticmethod
+    def _stamp_emission(seq: _SeqState, now: float) -> None:
+        if not seq.t_first_token:
+            seq.t_first_token = now
+        seq.t_last_token = now
+
     def _release(self, seq: _SeqState) -> None:
         self._reserved -= seq.reserved_remaining  # return unused reservation
         seq.reserved_remaining = 0
@@ -342,6 +367,45 @@ class RaggedInferenceEngine:
         del self._running[seq.slot]
         seq.slot = -1
         self._results[seq.uid] = seq
+        if self.telemetry.enabled:
+            self._emit_request_span(seq)
+
+    def _emit_request_span(self, seq: _SeqState) -> None:
+        """One request-lifecycle span at completion: queue wait, TTFT, mean
+        per-token decode latency, preemption count (FastGen's serving SLO
+        metrics, machine-readable)."""
+        tel = self.telemetry
+        n_gen = len(seq.generated)
+        ttft = (seq.t_first_token - seq.t_enqueue
+                if seq.t_first_token and seq.t_enqueue else None)
+        queue_wait = (seq.t_admit - seq.t_enqueue
+                      if seq.t_admit and seq.t_enqueue else None)
+        # mean inter-token latency after the first token; chunked dispatch
+        # (run-ahead / fused pipeline) amortizes inside the mean
+        decode_latency = ((seq.t_last_token - seq.t_first_token) / (n_gen - 1)
+                          if n_gen > 1 and seq.t_first_token else None)
+        dur = (seq.t_last_token - seq.t_enqueue
+               if seq.t_last_token and seq.t_enqueue else 0.0)
+        tel.emit_span(
+            "inference/request", dur, uid=str(seq.uid),
+            queue_wait_s=queue_wait, ttft_s=ttft,
+            decode_latency_s=decode_latency,
+            prompt_tokens=len(seq.prompt), new_tokens=n_gen,
+            preemptions=seq.preemptions)
+        tel.counter("inference_requests_total", "requests completed").inc()
+        tel.counter("inference_tokens_generated_total",
+                    "tokens generated").inc(n_gen)
+        if seq.preemptions:
+            tel.counter("inference_preemptions_total",
+                        "decode steps stalled on KV-pool pressure").inc(
+                            seq.preemptions)
+        if ttft is not None:
+            tel.histogram("inference_ttft_seconds",
+                          "time to first token").observe(ttft)
+        if decode_latency is not None:
+            tel.histogram("inference_decode_latency_seconds",
+                          "mean inter-token decode latency").observe(
+                              decode_latency)
 
     def _build_step(self) -> Callable:
         fwd = self.spec.ragged_forward_fn
@@ -442,12 +506,15 @@ class RaggedInferenceEngine:
         self.tokens_scheduled += k * t
         self.tokens_padded += k * (bucket - t)
         emit: dict = {}
+        now = time.perf_counter() if self.telemetry.enabled else 0.0
         for j, s in enumerate(seqs):
             for i in range(k):
                 tok = int(out[i, j])
                 s.generated.append(tok)
                 s.pos += 1
                 emit[s.uid] = tok
+                if now:
+                    self._stamp_emission(s, now)
                 if s.finished:
                     break  # tokens past EOS stay in the pool; freed on release
             if s.finished:
@@ -844,12 +911,15 @@ class RaggedInferenceEngine:
         return True
 
     def _append_tokens(self, seq: _SeqState, toks, out: dict) -> None:
+        now = time.perf_counter() if self.telemetry.enabled else 0.0
         for t in toks:
             if seq.finished:
                 break  # post-EOS speculation: discard
             seq.generated.append(int(t))
             out[seq.uid] = int(t)
             self.tokens_emitted += 1
+            if now:
+                self._stamp_emission(seq, now)
 
     def _reconcile_oldest(self) -> dict:
         """Read back the OLDEST in-flight chunk's tokens and fold them into
@@ -904,7 +974,10 @@ class RaggedInferenceEngine:
             if not seq.in_decode or n >= budget:
                 continue
             if not self._ensure_capacity(seq, seq.pos + 1):
-                continue  # pool pressure: this seq stalls one step
+                # pool pressure: this seq stalls (is preempted) for one step
+                seq.preemptions += 1
+                self.preemptions += 1
+                continue
             tokens[n] = seq.token_at(seq.pos)
             slots[n] = seq.slot
             positions[n] = seq.pos
@@ -927,6 +1000,8 @@ class RaggedInferenceEngine:
             seq.reserved_remaining = worst
             self._reserved += worst
             self._running[seq.slot] = seq
+            if self.telemetry.enabled:
+                seq.t_admit = time.perf_counter()
 
     def _emit_tokens(self, logits, emit) -> dict:
         """Shared step epilogue: pick at the emit indices (greedy, or the
@@ -965,10 +1040,13 @@ class RaggedInferenceEngine:
             else:
                 picked = np.asarray(
                     jnp.argmax(logits[idx].astype(jnp.float32), axis=-1))
+            now = time.perf_counter() if self.telemetry.enabled else 0.0
             for (_, seq), tok in zip(emit, picked):
                 seq.generated.append(int(tok))
                 out[seq.uid] = int(tok)
                 self.tokens_emitted += 1
+                if now:
+                    self._stamp_emission(seq, now)
                 if seq.finished:
                     self._release(seq)
         return out
@@ -993,6 +1071,34 @@ class RaggedInferenceEngine:
         per-sequence state)."""
         if not self.has_work:
             return {}
+        out = self._step_impl()
+        if self.telemetry.enabled:
+            self._sample_step_telemetry()
+        return out
+
+    def _sample_step_telemetry(self) -> None:
+        """Scheduler-state gauges after each step: KV-page occupancy, queue
+        depth, cumulative dispatch/padding counters."""
+        tel = self.telemetry
+        usable = self.cfg.num_blocks - 1  # block 0 is scratch
+        free = self.allocator.free_blocks
+        g = tel.gauge
+        g("kv_pages_free", "free KV blocks").set(free)
+        g("kv_page_occupancy",
+          "fraction of usable KV blocks in use").set(
+              (usable - free) / max(usable, 1))
+        g("inference_queue_depth", "requests waiting for admission").set(
+            len(self._queued))
+        g("inference_running_seqs", "admitted sequences").set(
+            len(self._running))
+        g("inference_tokens_scheduled", "useful token-slots scheduled").set(
+            self.tokens_scheduled)
+        g("inference_tokens_padded", "padding token-slots scheduled").set(
+            self.tokens_padded)
+        g("inference_dispatch_count", "device dispatches issued").set(
+            self.dispatch_count)
+
+    def _step_impl(self) -> dict:
         if self.cfg.fused_chunk >= 2:
             return self._step_fused()
         # admission FIRST: a newly admitted sequence is in prefill, which

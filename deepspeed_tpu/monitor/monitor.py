@@ -37,6 +37,9 @@ class Monitor:
     def flush(self) -> None:
         pass
 
+    def close(self) -> None:
+        self.flush()
+
 
 class TensorBoardMonitor(Monitor):
     def __init__(self, cfg: dict):
@@ -62,29 +65,52 @@ class TensorBoardMonitor(Monitor):
         if self.enabled:
             self.writer.flush()
 
+    def close(self):
+        if self.enabled:
+            self.writer.close()
+
 
 class CSVMonitor(Monitor):
     def __init__(self, cfg: dict):
         self.enabled = False
+        self._files: dict[str, Any] = {}  # tag -> (handle, csv.writer)
         if not _is_rank0():
             return
         self.dir = os.path.join(cfg.get("output_path", "./csv_logs"),
                                 cfg.get("job_name", "dstpu"))
         os.makedirs(self.dir, exist_ok=True)
-        self._files: dict[str, Any] = {}
         self.enabled = True
 
     def write_events(self, event_list):
         if not self.enabled:
             return
+        touched = set()
         for tag, value, step in event_list:
-            fname = os.path.join(self.dir, tag.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
+            # one cached append handle per tag: reopening the file for every
+            # event turns each scalar into an open/close syscall pair
+            entry = self._files.get(tag)
+            if entry is None:
+                fname = os.path.join(self.dir, tag.replace("/", "_") + ".csv")
+                new = not os.path.exists(fname)
+                f = open(fname, "a", newline="")
+                entry = self._files[tag] = (f, csv.writer(f))
                 if new:
-                    w.writerow(["step", tag])
-                w.writerow([int(step), float(value)])
+                    entry[1].writerow(["step", tag])
+            entry[1].writerow([int(step), float(value)])
+            touched.add(tag)
+        for tag in touched:
+            # one flush per batch keeps the file readable between steps
+            # (readers tail these CSVs mid-run) without per-event reopens
+            self._files[tag][0].flush()
+
+    def flush(self):
+        for f, _ in self._files.values():
+            f.flush()
+
+    def close(self):
+        for f, _ in self._files.values():
+            f.close()
+        self._files.clear()
 
 
 class WandbMonitor(Monitor):
@@ -168,3 +194,7 @@ class MonitorMaster(Monitor):
     def flush(self):
         for w in self.writers:
             w.flush()
+
+    def close(self):
+        for w in self.writers:
+            w.close()

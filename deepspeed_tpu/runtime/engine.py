@@ -398,10 +398,44 @@ class Engine:
                 self.model_spec.flops_per_token(config.sequence_length)
                 * config.sequence_length
             )
+        elif config.sequence_length:
+            # the model exposes no flops_per_token: fall back to the flops
+            # profiler's analytic per-layer count so tflops() reports a real
+            # number instead of 0.0 (fwd x3 ~ fwd+bwd training flops)
+            try:
+                from deepspeed_tpu.profiling.flops_profiler import get_model_profile
+
+                prof = get_model_profile(
+                    self.model_spec, batch=1, seq=config.sequence_length,
+                    with_compiled=False)
+                if prof.flops_fwd:
+                    self.tput_timer.flops_per_sample = 3.0 * prof.flops_fwd
+            except Exception as e:
+                log_dist(f"analytic flops estimate unavailable: {e}", ranks=[0])
 
         from deepspeed_tpu.monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(config.monitor)
+
+        # structured telemetry bus (deepspeed_tpu/telemetry/): step spans, HBM
+        # watermarks, comm counters, checkpoint durations — one registry that
+        # the JSONL/Prometheus exporters and the monitor bridge all read
+        from deepspeed_tpu import telemetry as _telemetry
+
+        self.telemetry = _telemetry.get_telemetry()
+        if config.telemetry.enabled:
+            self.telemetry.configure(config.telemetry, monitor=self.monitor)
+        if self.tput_timer.flops_per_sample:
+            if self.telemetry.enabled:
+                self.telemetry.gauge(
+                    "train_flops_per_sample",
+                    "analytic FLOPs per training sample").set(
+                        self.tput_timer.flops_per_sample)
+            if self.monitor.enabled:
+                self.monitor.write_events([(
+                    "Train/flops_per_sample",
+                    float(self.tput_timer.flops_per_sample), 0)])
+        self._prev_step_wall = 0.0  # host wall clock of the previous _after_step
 
         if (config.progressive_layer_drop.enabled
                 and not self.model_spec.supports_pld):
@@ -838,6 +872,7 @@ class Engine:
         return jnp.mean(losses), acc
 
     def _build_train_batch_fn(self, use_qgrad: bool | None = None):
+        self._record_comms_plan()
         if self._qgrad if use_qgrad is None else use_qgrad:
             return self._build_train_batch_fn_qgrad()
         if (self.topo.size("pipeline") > 1
@@ -853,6 +888,29 @@ class Engine:
             return new_params, new_opt, new_scale, metrics
 
         return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2))
+
+    def _record_comms_plan(self) -> None:
+        """Static comms plan of the fused step (comms_logging trace ledger).
+
+        GSPMD inserts the gradient-sync collectives from shardings — no
+        wrapper call ever fires at trace time — so the per-step plan is
+        recorded here once per program build: grad bytes are fp32 leaves."""
+        from deepspeed_tpu.utils.comms_logging import COMMS_LOGGER
+
+        dp, fs = self.topo.size("data"), self.topo.size("fsdp")
+        if dp <= 1 and fs <= 1:
+            return
+        grad_bytes = 4 * sum(
+            int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+        if fs > 1:
+            # ZeRO over fsdp: reduce-scatter grads, all-gather updated params
+            COMMS_LOGGER.append_traced("reduce_scatter", grad_bytes, "fsdp",
+                                       fs, caller="train_batch_fn")
+            COMMS_LOGGER.append_traced("all_gather", grad_bytes, "fsdp",
+                                       fs, caller="train_batch_fn")
+        if dp > 1:
+            COMMS_LOGGER.append_traced("all_reduce", grad_bytes, "data",
+                                       dp, caller="train_batch_fn")
 
     def _build_train_batch_fn_qgrad(self):
         """Fused step with qgZ gradient reduction (reference ZeRO++
@@ -1489,7 +1547,14 @@ class Engine:
         """Eval-mode loss (reference ``engine.forward:2675``; jitted, no grads)."""
         if self._eval_jit is None:
             self._eval_jit = self._build_eval_fn()
-        return self._eval_jit(self.params, self._put_microbatch(batch), self._next_rng())
+        t0 = time.perf_counter() if self.telemetry.enabled else 0.0
+        out = self._eval_jit(self.params, self._put_microbatch(batch),
+                             self._next_rng())
+        if t0:
+            self.telemetry.emit_span("train/forward",
+                                     time.perf_counter() - t0,
+                                     step=self.global_steps)
+        return out
 
     eval_batch = forward
 
@@ -1523,6 +1588,7 @@ class Engine:
                 self._grad_ns(),
             )
             self._acc_count = 0
+        t0 = time.perf_counter() if self.telemetry.enabled else 0.0
         loss, self._acc_grads = self._accum_jit(
             self.params,
             self._acc_grads,
@@ -1530,6 +1596,13 @@ class Engine:
             self._next_rng(),
             self._put_microbatch(batch),
         )
+        if t0:
+            # host-visible fwd+bwd dispatch time (the reference's fwd/bwd
+            # timers are the same host wall clock under async dispatch)
+            self.telemetry.emit_span("train/backward",
+                                     time.perf_counter() - t0,
+                                     step=self.global_steps,
+                                     micro_step=self.micro_steps)
         self._acc_count += 1
         self.micro_steps += 1
         return loss
@@ -1545,6 +1618,7 @@ class Engine:
             return
         if self._apply_jit is None:
             self._apply_jit = self._build_apply_fn()
+        t0 = time.perf_counter() if self.telemetry.enabled else 0.0
         self.params, self.opt_state, self.scale_state, metrics = self._apply_jit(
             self.params,
             self.opt_state,
@@ -1553,6 +1627,10 @@ class Engine:
             jnp.float32(self._acc_count),
             jnp.int32(self.global_steps),
         )
+        if t0:
+            self.telemetry.emit_span("train/opt_step",
+                                     time.perf_counter() - t0,
+                                     step=self.global_steps)
         self._acc_grads = None
         self._acc_count = 0
         self._after_step(metrics)
@@ -1617,8 +1695,11 @@ class Engine:
             )
         self.lr_scheduler.step()
         self._last_metrics = metrics  # device arrays; fetched on demand
-        if self.monitor.enabled:
+        if self.monitor.enabled or self.telemetry.enabled:
             self._last_metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        if self.telemetry.enabled:
+            self._emit_step_telemetry(self._last_metrics)
+        if self.monitor.enabled:
             # reference tags (engine.py:3360-3390 _write_monitor)
             events = [
                 ("Train/Samples/lr", float(self._last_metrics["lr"]), self.global_samples),
@@ -1648,6 +1729,51 @@ class Engine:
             )
         self.step_tracer.after_step(self.global_steps - 1)
 
+    def _emit_step_telemetry(self, vals: dict) -> None:
+        """Per-step span + gauges + HBM watermark (telemetry enabled only).
+
+        ``vals`` are host numpy scalars (the conversion is this path's settle
+        point — same cost the monitor path already pays). The span duration is
+        the fused-path host wall clock from ThroughputTimer; the fwd/bwd/step
+        parity path falls back to the inter-step delta.
+        """
+        tel = self.telemetry
+        now = time.perf_counter()
+        dur = self.tput_timer.last_duration or (
+            now - self._prev_step_wall if self._prev_step_wall else 0.0)
+        self._prev_step_wall = now
+        step = self.global_steps
+        skipped = bool(vals["skipped"])
+        attrs = {
+            "lr": float(vals["lr"]),
+            "grad_norm": float(vals["grad_norm"]),
+            "skipped": skipped,
+        }
+        if "loss" in vals:
+            attrs["loss"] = float(vals["loss"])
+        if "loss_scale" in vals:
+            attrs["loss_scale"] = float(vals["loss_scale"])
+        tel.emit_span("train/step", dur, step=step, **attrs)
+        tel.counter("train_steps_total", "optimizer steps taken").inc()
+        tel.counter("train_samples_total", "samples consumed").inc(
+            int(self.config.train_batch_size or 0))
+        g = tel.gauge
+        g("train_loss", "last step loss").set(attrs.get("loss", 0.0))
+        g("train_grad_norm", "last step global grad norm").set(attrs["grad_norm"])
+        g("train_lr", "last step learning rate").set(attrs["lr"])
+        g("train_samples_per_second", "throughput").set(
+            self.tput_timer.throughput())
+        if self.tput_timer.flops_per_sample:
+            g("train_tflops", "achieved TFLOPS").set(self.tput_timer.tflops())
+        if "loss_scale" in attrs:
+            g("train_loss_scale", "dynamic loss scale").set(attrs["loss_scale"])
+        if skipped:
+            tel.counter("train_overflow_steps_total",
+                        "fp16 overflow-skipped steps").inc()
+            tel.event("train/overflow", step=step,
+                      loss_scale=attrs.get("loss_scale"))
+        tel.sample_memory(step=step)
+
     # ------------------------------------------------------------------ checkpoint
     def save_checkpoint(self, save_dir: str, tag: str | None = None,
                         client_state: dict | None = None, save_latest: bool = True):
@@ -1666,6 +1792,7 @@ class Engine:
         from deepspeed_tpu.checkpoint import sharded
         from deepspeed_tpu.checkpoint import serialization as ser
 
+        ckpt_t0 = time.perf_counter()
         tag = tag or f"global_step{self.global_steps}"
         ckpt_dir = os.path.join(save_dir, str(tag))
         manifest = {
@@ -1745,6 +1872,18 @@ class Engine:
             self._ckpt_writer.start()
         else:
             flush()
+        if self.telemetry.enabled:
+            # async saves report the dispatch (snapshot) cost — the training
+            # stall they actually cause — not the background flush
+            dur = time.perf_counter() - ckpt_t0
+            self.telemetry.emit_span(
+                "checkpoint/save", dur, step=self.global_steps, tag=str(tag),
+                async_flush=bool(self.config.checkpoint.async_save))
+            self.telemetry.gauge(
+                "checkpoint_last_save_seconds",
+                "wall clock of the last checkpoint save").set(dur)
+            self.telemetry.counter(
+                "checkpoint_saves_total", "checkpoints written").inc()
         return ckpt_dir
 
     def _join_ckpt_writer(self):
@@ -1771,6 +1910,7 @@ class Engine:
 
         from deepspeed_tpu.checkpoint import sharded
 
+        ckpt_t0 = time.perf_counter()
         self._join_ckpt_writer()
         tag = tag or ckpt.latest_tag(load_dir)
         if tag is None:
@@ -1857,6 +1997,13 @@ class Engine:
             f"{manifest['world_size']}, now {self.topo.world_size})",
             ranks=[0],
         )
+        if self.telemetry.enabled:
+            dur = time.perf_counter() - ckpt_t0
+            self.telemetry.emit_span(
+                "checkpoint/load", dur, step=self.global_steps, tag=str(tag))
+            self.telemetry.gauge(
+                "checkpoint_last_load_seconds",
+                "wall clock of the last checkpoint load").set(dur)
         return ckpt_dir, manifest.get("client_state", {})
 
     # ------------------------------------------------------------------ accessors
@@ -1892,6 +2039,26 @@ class Engine:
         from deepspeed_tpu.accelerator.real_accelerator import get_accelerator
 
         return get_accelerator().memory_stats()
+
+    # ------------------------------------------------------------------ teardown
+    def destroy(self) -> None:
+        """Engine teardown (reference ``engine.destroy``): stop the trace
+        capture (so an in-window run still lands its profile on disk), join
+        any async checkpoint flush, and flush/close monitor + telemetry
+        sinks. Idempotent; the StepTracer's own ``atexit`` hook covers
+        callers that never get here."""
+        if getattr(self, "_destroyed", False):
+            return
+        self._destroyed = True
+        self.step_tracer.close()
+        try:
+            self._join_ckpt_writer()
+        except RuntimeError:
+            raise
+        finally:
+            self.monitor.close()
+            if self.telemetry.enabled:
+                self.telemetry.flush()
 
 
 def initialize(

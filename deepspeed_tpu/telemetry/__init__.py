@@ -1,0 +1,47 @@
+"""Unified structured telemetry (docs/OBSERVABILITY.md).
+
+One process-local bus joins what the reference scatters across monitor/
+comms-logging/timer prints: a typed metrics registry (counters, gauges,
+histograms with labels), a span/event log (training step spans, inference
+request lifecycles, checkpoint durations), a per-step HBM watermark sampler,
+and pluggable exporters (JSONL file sink, Prometheus text exposition over
+stdlib HTTP, and the existing ``MonitorMaster`` as a bridge sink).
+
+Typical use::
+
+    from deepspeed_tpu import telemetry
+
+    telemetry.configure(enabled=True, jsonl_path="/tmp/run.jsonl",
+                        prometheus={"enabled": True, "port": 9464})
+    telemetry.get_telemetry().counter("my_events_total").inc()
+    ...
+    telemetry.get_telemetry().dump("/tmp/run_metrics.json")
+
+Training runs enable it declaratively via the ``telemetry: {...}`` config
+block; ``deepspeed_tpu.initialize`` wires the engine emit points.
+"""
+
+from deepspeed_tpu.telemetry.core import TELEMETRY, Telemetry  # noqa: F401
+from deepspeed_tpu.telemetry.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+def get_telemetry() -> Telemetry:
+    return TELEMETRY
+
+
+def configure(cfg=None, monitor=None, **overrides) -> Telemetry:
+    """Configure the process singleton (see :meth:`Telemetry.configure`)."""
+    return TELEMETRY.configure(cfg, monitor=monitor, **overrides)
+
+
+def snapshot() -> dict:
+    return TELEMETRY.snapshot()
+
+
+def dump(path: str) -> dict:
+    return TELEMETRY.dump(path)

@@ -1,0 +1,227 @@
+"""The telemetry bus: one process-local singleton joining the metrics
+registry, the span/event log, the HBM watermark sampler, and the exporters.
+
+Disabled (the default) it is a no-op behind a single ``if not self.enabled``
+flag check on every emit path — no clocks read, no dicts written — so the
+training/inference hot paths pay nothing until a run opts in via the
+``telemetry: {...}`` config block (see docs/OBSERVABILITY.md).
+
+Event records share one shape across sinks::
+
+    {"type": "span"|"event"|"gauge"|"snapshot", "name": ..., "ts": <unix s>,
+     "step": <optional>, "dur_s": <spans>, ...free-form attrs...}
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+from deepspeed_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+def _as_cfg_dict(cfg) -> dict:
+    if cfg is None:
+        return {}
+    if isinstance(cfg, dict):
+        return dict(cfg)
+    if hasattr(cfg, "to_dict"):
+        return dict(cfg.to_dict())
+    # plain dataclass / namespace
+    return {k: v for k, v in vars(cfg).items() if not k.startswith("_")}
+
+
+class Telemetry:
+    """Process-local telemetry bus (module singleton: ``TELEMETRY``)."""
+
+    def __init__(self):
+        self.enabled = False
+        self.registry = MetricsRegistry()
+        self._sinks: list = []
+        self._prometheus = None
+        self._sampler = None
+        self._hbm_watermarks = True
+        self._flush_interval = 100
+        self._since_flush = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- configure
+    def configure(self, cfg=None, monitor=None, **overrides) -> "Telemetry":
+        """(Re)build sinks from a ``TelemetryConfig`` / dict / kwargs.
+
+        Idempotent: reconfiguring tears down the previous sinks and HTTP
+        server first, so multiple engines in one process share one bus.
+        """
+        opts = _as_cfg_dict(cfg)
+        opts.update(overrides)
+        with self._lock:
+            self._teardown_locked()
+            self.enabled = bool(opts.get("enabled", True))
+            if not self.enabled:
+                return self
+            self._hbm_watermarks = bool(opts.get("hbm_watermarks", True))
+            self._flush_interval = max(1, int(opts.get("flush_interval_events",
+                                                       100)))
+            jsonl_path = opts.get("jsonl_path")
+            if jsonl_path:
+                from deepspeed_tpu.telemetry.exporters import JsonlSink
+
+                self._sinks.append(JsonlSink(str(jsonl_path)))
+            if opts.get("monitor_sink") and monitor is not None:
+                from deepspeed_tpu.telemetry.exporters import MonitorSink
+
+                self._sinks.append(MonitorSink(monitor))
+            prom = opts.get("prometheus") or {}
+            if prom.get("enabled"):
+                from deepspeed_tpu.telemetry.exporters import PrometheusExporter
+
+                self._prometheus = PrometheusExporter(
+                    self.registry,
+                    host=str(prom.get("host", "127.0.0.1")),
+                    port=int(prom.get("port", 9464)),
+                )
+        self.event("telemetry/configured",
+                   sinks=[type(s).__name__ for s in self._sinks],
+                   prometheus_port=(self._prometheus.port
+                                    if self._prometheus else None))
+        return self
+
+    @property
+    def prometheus_port(self) -> int | None:
+        return self._prometheus.port if self._prometheus else None
+
+    # ------------------------------------------------------------- metrics
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self.registry.counter(name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self.registry.gauge(name, help)
+
+    def histogram(self, name: str, help: str = "", **kw) -> Histogram:
+        return self.registry.histogram(name, help, **kw)
+
+    # ------------------------------------------------------------- events
+    def emit(self, record: dict) -> None:
+        """Append one record to every sink (stamps ``ts`` if absent)."""
+        if not self.enabled:
+            return
+        record.setdefault("ts", time.time())
+        for sink in self._sinks:
+            try:
+                sink.emit(record)
+            except Exception:
+                pass  # a broken sink must never take down the step loop
+        self._since_flush += 1
+        if self._since_flush >= self._flush_interval:
+            self.flush()
+
+    def event(self, name: str, step: int | None = None, **attrs) -> None:
+        if not self.enabled:
+            return
+        record = {"type": "event", "name": name}
+        if step is not None:
+            record["step"] = int(step)
+        record.update({k: v for k, v in attrs.items() if v is not None})
+        self.emit(record)
+
+    def emit_span(self, name: str, dur_s: float, step: int | None = None,
+                  **attrs) -> None:
+        """Record a pre-measured span: JSONL record + latency histogram."""
+        if not self.enabled:
+            return
+        record = {"type": "span", "name": name, "dur_s": float(dur_s)}
+        if step is not None:
+            record["step"] = int(step)
+        record.update({k: v for k, v in attrs.items() if v is not None})
+        self.emit(record)
+        self.registry.histogram(
+            "span_seconds", "span durations by name").observe(dur_s, name=name)
+
+    @contextmanager
+    def span(self, name: str, step: int | None = None, **attrs):
+        """Context manager measuring wall clock around a block."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.emit_span(name, time.perf_counter() - t0, step=step, **attrs)
+
+    def sample_memory(self, step: int | None = None) -> dict:
+        """Per-step HBM watermark gauges (no device sync)."""
+        if not self.enabled or not self._hbm_watermarks:
+            return {}
+        if self._sampler is None:
+            from deepspeed_tpu.telemetry.memory import HbmWatermarkSampler
+
+            self._sampler = HbmWatermarkSampler(self)
+        return self._sampler.sample(step)
+
+    # ------------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        """The full registry as plain data (JSON-serializable)."""
+        return {"ts": time.time(), "metrics": self.registry.snapshot()}
+
+    def dump(self, path: str) -> dict:
+        """Persist ``snapshot()`` as a JSON file; returns the snapshot."""
+        import json
+        import os
+
+        snap = self.snapshot()
+        parent = os.path.dirname(os.path.abspath(path))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=2, default=str)
+        return snap
+
+    # ------------------------------------------------------------- lifecycle
+    def flush(self) -> None:
+        self._since_flush = 0
+        for sink in self._sinks:
+            try:
+                sink.flush()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        """Emit a final registry snapshot record, then tear down all sinks."""
+        if self.enabled and self._sinks:
+            self.emit({"type": "snapshot", **self.snapshot()})
+        with self._lock:
+            self._teardown_locked()
+        self.enabled = False
+
+    def reset(self) -> None:
+        """Back to the pristine disabled state (test isolation)."""
+        with self._lock:
+            self._teardown_locked()
+        self.enabled = False
+        self.registry.reset()
+
+    def _teardown_locked(self) -> None:
+        for sink in self._sinks:
+            try:
+                sink.close()
+            except Exception:
+                pass
+        self._sinks = []
+        if self._prometheus is not None:
+            try:
+                self._prometheus.close()
+            except Exception:
+                pass
+            self._prometheus = None
+        self._sampler = None
+        self._since_flush = 0
+
+
+TELEMETRY = Telemetry()

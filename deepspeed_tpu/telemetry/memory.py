@@ -1,0 +1,48 @@
+"""Per-step HBM watermark sampler backed by ``accelerator.memory_stats()``.
+
+On TPU the stats come from ``device.memory_stats()`` (bytes_in_use /
+bytes_limit / peak_bytes_in_use); the CPU test accelerator reports ru_maxrss.
+Sampling is a host-side dict read — it never syncs the device — so it is safe
+to run every step while the async dispatch pipeline is in flight.
+"""
+
+from __future__ import annotations
+
+
+class HbmWatermarkSampler:
+    """Reads accelerator memory stats into gauges + one JSONL gauge record."""
+
+    GAUGES = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+    def __init__(self, telemetry):
+        self._telemetry = telemetry
+        self._accelerator = None
+        self._broken = False
+
+    def sample(self, step: int | None = None) -> dict:
+        if self._broken:
+            return {}
+        if self._accelerator is None:
+            from deepspeed_tpu.accelerator.real_accelerator import get_accelerator
+
+            self._accelerator = get_accelerator()
+        try:
+            stats = self._accelerator.memory_stats() or {}
+        except Exception:
+            # a backend without memory stats must not take down training
+            self._broken = True
+            return {}
+        tel = self._telemetry
+        record = {"type": "gauge", "name": "hbm_watermark"}
+        if step is not None:
+            record["step"] = int(step)
+        for key in self.GAUGES:
+            if key in stats:
+                value = float(stats[key])
+                tel.gauge(f"hbm_{key}", "accelerator memory watermark").set(value)
+                record[key] = value
+        if "bytes_in_use" in record:
+            # MonitorSink plots records with a scalar `value`
+            record["value"] = record["bytes_in_use"]
+        tel.emit(record)
+        return stats

@@ -57,17 +57,20 @@ class _OpRecord:
     count: int = 0
     total_bytes: int = 0
     total_latency: float = 0.0  # seconds; 0 for trace-time records
+    n_ranks: int = 1  # participants of the last call (bandwidth accounting)
     sizes: dict = field(default_factory=lambda: defaultdict(lambda: [0, 0.0]))  # size -> [count, lat]
 
 
 class CommsLogger:
     def __init__(self, enabled: bool = False, verbose: bool = False, debug: bool = False,
-                 prof_all: bool = True, prof_ops: list | None = None):
+                 prof_all: bool = True, prof_ops: list | None = None,
+                 straggler_warn_ratio: float = 2.0):
         self.enabled = enabled
         self.verbose = verbose
         self.debug = debug
         self.prof_all = prof_all
         self.prof_ops = prof_ops or []
+        self.straggler_warn_ratio = straggler_warn_ratio
         self.traced: dict[str, _OpRecord] = defaultdict(_OpRecord)
         self.eager: dict[str, _OpRecord] = defaultdict(_OpRecord)
 
@@ -77,6 +80,8 @@ class CommsLogger:
         self.debug = cfg.debug
         self.prof_all = cfg.prof_all
         self.prof_ops = list(cfg.prof_ops)
+        self.straggler_warn_ratio = float(
+            getattr(cfg, "straggler_warn_ratio", self.straggler_warn_ratio))
 
     def _should_log(self, op_name: str) -> bool:
         return self.enabled and (self.prof_all or op_name in self.prof_ops)
@@ -84,11 +89,25 @@ class CommsLogger:
     # ------------------------------------------------------- trace-time ledger
     def append_traced(self, op_name: str, size_bytes: int, axis: str, n_ranks: int,
                       caller: str = "") -> None:
+        # both ledgers also feed the telemetry metrics registry
+        # (deepspeed_tpu/telemetry/): counters survive the run in the JSONL
+        # snapshot / Prometheus endpoint even when this logger only prints
+        from deepspeed_tpu.telemetry import TELEMETRY
+
+        if TELEMETRY.enabled:
+            TELEMETRY.counter(
+                "comm_traced_calls_total",
+                "collectives captured at step-trace time").inc(1, op=op_name)
+            TELEMETRY.counter(
+                "comm_traced_bytes_total",
+                "bytes moved by trace-time collectives").inc(
+                    size_bytes, op=op_name)
         if not self._should_log(op_name):
             return
         rec = self.traced[op_name]
         rec.count += 1
         rec.total_bytes += size_bytes
+        rec.n_ranks = max(n_ranks, 1)
         rec.sizes[size_bytes][0] += 1
         if self.verbose:
             log_dist(
@@ -99,12 +118,27 @@ class CommsLogger:
 
     # ------------------------------------------------------- eager ledger
     def append_eager(self, op_name: str, size_bytes: int, latency_s: float, n_ranks: int) -> None:
+        from deepspeed_tpu.telemetry import TELEMETRY
+
+        if TELEMETRY.enabled:
+            TELEMETRY.counter(
+                "comm_eager_calls_total",
+                "host-level collectives issued").inc(1, op=op_name)
+            TELEMETRY.counter(
+                "comm_eager_bytes_total",
+                "bytes moved by host-level collectives").inc(
+                    size_bytes, op=op_name)
+            TELEMETRY.histogram(
+                "comm_eager_latency_seconds",
+                "host-level collective wall clock").observe(
+                    latency_s, op=op_name)
         if not self._should_log(op_name):
             return
         rec = self.eager[op_name]
         rec.count += 1
         rec.total_bytes += size_bytes
         rec.total_latency += latency_s
+        rec.n_ranks = max(n_ranks, 1)
         s = rec.sizes[size_bytes]
         s[0] += 1
         s[1] += latency_s
@@ -124,9 +158,15 @@ class CommsLogger:
         lines.append("Comms summary (eager host-level collectives):")
         for op, rec in sorted(self.eager.items()):
             avg_ms = 1e3 * rec.total_latency / max(rec.count, 1)
+            # average-size/average-latency bandwidth per call (the reference
+            # prints algbw/busbw per row; a sum/sum ratio would let one huge
+            # transfer mask many slow small ones)
+            algbw, busbw = calc_bw_log(
+                op, rec.total_bytes / max(rec.count, 1),
+                rec.total_latency / max(rec.count, 1), rec.n_ranks)
             lines.append(
                 f"  {op:>18}: calls={rec.count:<6} total={rec.total_bytes / 1e6:.2f} MB "
-                f"avg={avg_ms:.3f}ms"
+                f"avg={avg_ms:.3f}ms algbw={algbw:.2f}GB/s busbw={busbw:.2f}GB/s"
             )
         if show_straggler:
             lines += self._straggler_lines()
@@ -143,13 +183,24 @@ class CommsLogger:
 
             if jax.process_count() <= 1:
                 return ["  (single process; no straggler data)"]
-            lines = ["Straggler analysis (min/max across processes):"]
+            lines = ["Straggler analysis (min/max across processes, "
+                     f"warn ratio {self.straggler_warn_ratio:.2f}):"]
             for op, rec in sorted(self.eager.items()):
                 mine = np.asarray([rec.total_latency], dtype=np.float32)
                 gathered = multihost_utils.process_allgather(mine)
-                lines.append(
-                    f"  {op:>18}: min={gathered.min() * 1e3:.3f}ms max={gathered.max() * 1e3:.3f}ms"
+                mn, mx = float(gathered.min()), float(gathered.max())
+                ratio = mx / max(mn, 1e-12)
+                line = (
+                    f"  {op:>18}: min={mn * 1e3:.3f}ms max={mx * 1e3:.3f}ms "
+                    f"ratio={ratio:.2f}"
                 )
+                if ratio > self.straggler_warn_ratio:
+                    line += "  <-- STRAGGLER"
+                    logger.warning(
+                        f"comm straggler: {op} max/min latency ratio "
+                        f"{ratio:.2f} exceeds {self.straggler_warn_ratio:.2f} "
+                        f"(min={mn * 1e3:.3f}ms max={mx * 1e3:.3f}ms)")
+                lines.append(line)
             return lines
         except Exception as e:  # pragma: no cover
             return [f"  (straggler gather failed: {e})"]

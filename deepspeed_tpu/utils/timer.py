@@ -104,12 +104,14 @@ class ThroughputTimer:
     step_count: int = field(default=0, init=False)
     _start: float = field(default=0.0, init=False)
     flops_per_sample: float = field(default=0.0, init=False)
+    last_duration: float = field(default=0.0, init=False)  # most recent start->stop
 
     def start(self) -> None:
         self._start = time.perf_counter()
 
     def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
         duration = time.perf_counter() - self._start
+        self.last_duration = duration
         self.total_elapsed += duration
         if global_step:
             self.step_count += 1

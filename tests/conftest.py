@@ -140,11 +140,13 @@ def _reset_global_state():
     """Each test builds its own topology; reset the module-level singletons."""
     yield
     from deepspeed_tpu.comm.topology import reset_topology
+    from deepspeed_tpu.telemetry import TELEMETRY
     from deepspeed_tpu.utils.comms_logging import COMMS_LOGGER
 
     reset_topology()
     COMMS_LOGGER.reset()
     COMMS_LOGGER.enabled = False
+    TELEMETRY.reset()
 
 
 @pytest.fixture
