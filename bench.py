@@ -635,6 +635,171 @@ def run_serve_subprocess(timeout: float = 900.0):
     return _run_flagged_subprocess("BENCH_SERVE", timeout)
 
 
+def serving_bench_main():
+    """Child process: the full serving tier under open-loop Poisson load.
+
+    Where serve_trial_main measures the *engine* (closed workload, direct
+    ``put()``/``generate_all()``), this drives the whole stack a deployment
+    would run — HTTP frontend → router admission → EngineLoop → ragged
+    engine — with a Poisson open-loop client (arrivals don't wait for
+    completions, the standard serving-bench discipline: closed-loop clients
+    hide queueing collapse). Reports the latencies a user would see:
+    p50/p99 TTFT, per-token decode latency, rejected-request rate (429s),
+    and goodput (useful tokens/s over wall time). One JSON line out.
+    """
+    import http.client
+    import threading
+
+    import numpy as np
+    import jax
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.ragged import RaggedConfig, RaggedInferenceEngine
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.serving import RouterConfig, build_server
+
+    e = os.environ
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model_cfg = llama.LlamaConfig(
+            vocab_size=32768, hidden_size=2048, intermediate_size=5632,
+            num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=1024)
+        n_req, max_new, rate = 48, 48, 8.0
+        prompt_lens = [64, 128, 256, 512]
+        max_seqs, budget, block, tile, ahead = 32, 1024, 32, 128, 48
+        fused, depth, max_prompt = 16, 3, 512
+    else:
+        model_cfg = llama.LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=688,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256)
+        n_req, max_new, rate = 10, 8, 4.0
+        prompt_lens = [16, 32, 64]
+        max_seqs, budget, block, tile, ahead = 4, 64, 16, 16, 8
+        fused, depth, max_prompt = 4, 2, 64
+    n_req = int(e.get("BENCH_SERVING_REQUESTS", n_req))
+    rate = float(e.get("BENCH_SERVING_RATE", rate))  # arrivals per second
+
+    tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_serving_telemetry.jsonl"))
+    telemetry.configure(enabled=True, jsonl_path=tel_path)
+
+    mbs = -(-(max_prompt + max_new) // block)
+    rcfg = RaggedConfig(
+        max_tokens_per_step=budget, max_seqs=max_seqs, block_size=block,
+        num_blocks=max_seqs * mbs + 1, max_blocks_per_seq=mbs,
+        decode_run_ahead=ahead, prefill_tile=tile,
+        fused_chunk=fused, pipeline_depth=depth)
+    engine = RaggedInferenceEngine(
+        model=lambda ctx: llama.build(model_cfg, ctx=ctx),
+        ragged_config=rcfg, seed=0)
+    engine.warmup()
+
+    frontend, router, loops = build_server(
+        [engine], router_cfg=RouterConfig(
+            max_queue_tokens=int(e.get("BENCH_SERVING_QUEUE_TOKENS", 2048))))
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model_cfg.vocab_size,
+                            (int(prompt_lens[i % len(prompt_lens)]),),
+                            dtype=np.int32).tolist() for i in range(n_req)]
+    rng.shuffle(prompts)
+    # open-loop schedule: exponential inter-arrival gaps, fixed before the
+    # clock starts so client-side jitter can't thin the offered load
+    gaps = rng.exponential(1.0 / rate, n_req)
+    arrivals = np.cumsum(gaps)
+
+    results = []  # dicts: {rejected, ttft, token_times, useful}
+    results_lock = threading.Lock()
+
+    def one_request(prompt):
+        conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                          timeout=120)
+        body = json.dumps({"prompt": prompt, "max_tokens": max_new,
+                           "stream": True})
+        t_send = time.perf_counter()
+        rec = {"rejected": False, "ttft": None, "token_times": [],
+               "useful": 0}
+        try:
+            conn.request("POST", "/v1/completions", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status == 429:
+                rec["rejected"] = True
+                resp.read()
+                return rec
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                if not line.startswith(b"data:"):
+                    continue
+                data = line[5:].strip()
+                if data == b"[DONE]":
+                    break
+                frame = json.loads(data)
+                if "token" in frame:
+                    now = time.perf_counter()
+                    if rec["ttft"] is None:
+                        rec["ttft"] = now - t_send
+                    rec["token_times"].append(now)
+            rec["useful"] = len(prompt) + len(rec["token_times"])
+        finally:
+            conn.close()
+        return rec
+
+    threads = []
+    t0 = time.perf_counter()
+    for i in range(n_req):
+        delay = t0 + arrivals[i] - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+
+        def fire(p=prompts[i]):
+            rec = one_request(p)
+            with results_lock:
+                results.append(rec)
+
+        th = threading.Thread(target=fire, daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=300)
+    wall = time.perf_counter() - t0
+    frontend.drain(timeout=60)
+
+    done = [r for r in results if not r["rejected"] and r["ttft"] is not None]
+    rejected = sum(1 for r in results if r["rejected"])
+    ttfts = [r["ttft"] for r in done]
+    gaps_s = [g for r in done
+              for g in np.diff(r["token_times"]).tolist()]
+    goodput = sum(r["useful"] for r in done) / wall if wall > 0 else 0.0
+    telemetry.TELEMETRY.close()
+    print(json.dumps({
+        "metric": "serving_frontend_poisson",
+        "serving_requests": n_req,
+        "serving_rate_rps": rate,
+        "serving_completed": len(done),
+        "serving_rejected": rejected,
+        "serving_rejected_rate": round(rejected / max(1, len(results)), 4),
+        "serving_ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2)
+        if ttfts else None,
+        "serving_ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 2)
+        if ttfts else None,
+        "serving_token_latency_ms": round(float(np.mean(gaps_s)) * 1e3, 2)
+        if gaps_s else None,
+        "serving_goodput_tokens_per_s": round(goodput, 1),
+        "serving_wall_s": round(wall, 2),
+        "backend": jax.default_backend(),
+        "telemetry_jsonl": tel_path,
+    }))
+    return 0
+
+
+def run_serving_subprocess(timeout: float = 900.0):
+    return _run_flagged_subprocess("BENCH_SERVING", timeout)
+
+
 def probe_device():
     """Probe backend/device kind in a throwaway subprocess so the parent never
     holds the TPU (a held chip would make every trial subprocess fail to init).
@@ -906,9 +1071,24 @@ def smoke_main():
 
 
 def main():
+    if "--mode" in sys.argv:
+        mode = sys.argv[sys.argv.index("--mode") + 1:][:1]
+        if mode != ["serving"]:
+            print(f"bench: unknown --mode {mode or '(missing)'}; "
+                  "supported: serving", file=sys.stderr)
+            return 2
+        result, err = run_serving_subprocess()
+        if result is None:
+            print(f"serving bench failed:\n{err}", file=sys.stderr)
+            return 1
+        print(json.dumps(result))
+        return 0
     if "--smoke" in sys.argv or os.environ.get("BENCH_SMOKE"):
         _enable_jit_cache()
         return smoke_main()
+    if os.environ.get("BENCH_SERVING"):
+        _enable_jit_cache()
+        return serving_bench_main()
     if os.environ.get("BENCH_SERVE"):
         _enable_jit_cache()
         return serve_trial_main()

@@ -13,7 +13,9 @@ death (hardware eviction, preemption, crash) it recomputes an admissible
 world size from the surviving hosts via ``elasticity.compute_elastic_config``
 and relaunches — resuming from the newest checkpoint (UCP resharding makes
 the world-size change free). A ``PreemptionHandler`` gives training loops the
-SIGTERM-checkpoint behavior megascale preemption notices need.
+SIGTERM-checkpoint behavior megascale preemption notices need, and lets the
+serving tier (``deepspeed_tpu/serving``) register drain callbacks on the same
+signal path (SIGTERM → stop admission → finish inflight → exit).
 """
 
 from __future__ import annotations
@@ -21,6 +23,7 @@ from __future__ import annotations
 import signal
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
@@ -132,34 +135,96 @@ class ElasticAgent:
 
 
 class PreemptionHandler:
-    """SIGTERM-triggered checkpoint hook (megascale preemption notice →
-    checkpoint, SURVEY §5.3). Install in the training process; poll
-    ``should_stop`` at step boundaries and the handler guarantees at most one
-    checkpoint is written on the way out."""
+    """SIGTERM-triggered graceful-stop hook (megascale preemption notice,
+    SURVEY §5.3) shared by the training and serving tiers.
 
-    def __init__(self, engine, save_dir: str, signals=(signal.SIGTERM,)):
+    One signal path, two registration styles:
+
+    - **training** (legacy contract): ``PreemptionHandler(engine, save_dir)``
+      registers a ``checkpoint`` callback; poll ``should_stop`` at step
+      boundaries and ``checkpoint_if_needed()`` writes at most one
+      checkpoint on the way out.
+    - **serving / anything else**: ``register(name, fn, immediate=...)``
+      adds arbitrary stop hooks. ``immediate=True`` callbacks run inside the
+      signal handler itself and must be non-blocking (e.g. "stop admitting
+      requests" — flag flips only); the rest run via ``drain()`` at a safe
+      boundary. Every callback runs at most once per preemption.
+
+    ``stop_event`` is a ``threading.Event`` set on the signal, so background
+    loops (the serving engine loop, a checkpoint writer) can wait on it
+    instead of polling ``should_stop``.
+    """
+
+    def __init__(self, engine=None, save_dir: str | None = None,
+                 signals=(signal.SIGTERM,)):
+        if engine is not None and save_dir is None:
+            raise ValueError("save_dir is required when an engine is given")
         self.engine = engine
         self.save_dir = save_dir
         self.should_stop = False
-        self._saved = False
+        self.stop_event = threading.Event()
+        self._callbacks: list[tuple[str, Callable[[], object], bool]] = []
+        self._ran: dict[str, object] = {}
         self._prev = {}
+        if engine is not None:
+            self.register("checkpoint", self._checkpoint)
         for sig in signals:
             self._prev[sig] = signal.signal(sig, self._on_signal)
 
+    def register(self, name: str, fn: Callable[[], object],
+                 immediate: bool = False) -> Callable[[], object]:
+        """Add a stop hook. ``immediate`` hooks fire inside the signal
+        handler (keep them to flag flips / Event sets); deferred hooks run
+        from ``drain()``/``checkpoint_if_needed()`` at a step boundary."""
+        if any(n == name for n, _, _ in self._callbacks):
+            raise ValueError(f"preemption callback {name!r} already registered")
+        self._callbacks.append((name, fn, immediate))
+        return fn
+
+    def _checkpoint(self):
+        path = self.engine.save_checkpoint(self.save_dir, tag="preempt")
+        join = getattr(self.engine, "_join_ckpt_writer", None)
+        if join is not None:
+            join()
+        return path
+
     def _on_signal(self, signum, frame):
         del frame
-        log_dist(f"preemption notice (signal {signum}): checkpoint + stop",
+        log_dist(f"preemption notice (signal {signum}): stop + drain",
                  ranks=[0])
         self.should_stop = True
+        self.stop_event.set()
+        for name, fn, immediate in self._callbacks:
+            if immediate and name not in self._ran:
+                self._ran[name] = None
+                try:
+                    self._ran[name] = fn()
+                except Exception:  # a failing hook must not mask the signal
+                    log_dist(f"preemption hook {name!r} failed", ranks=[0])
+
+    def _run_once(self, name: str, fn: Callable[[], object]):
+        if name not in self._ran:
+            self._ran[name] = fn()
+        return self._ran[name]
+
+    def drain(self) -> dict:
+        """Run every registered callback not already fired, each at most
+        once; call at a safe boundary after ``should_stop``. Returns
+        ``{name: result}`` for everything that has run."""
+        if not self.should_stop:
+            return {}
+        for name, fn, _ in self._callbacks:
+            self._run_once(name, fn)
+        return dict(self._ran)
 
     def checkpoint_if_needed(self) -> str | None:
-        """Call at the step boundary once ``should_stop`` is set."""
-        if self.should_stop and not self._saved:
-            self._saved = True
-            path = self.engine.save_checkpoint(self.save_dir, tag="preempt")
-            self.engine._join_ckpt_writer()
-            return path
-        return None
+        """Legacy training contract: at most one preempt checkpoint, written
+        at the step boundary once ``should_stop`` is set."""
+        if not self.should_stop or self.engine is None:
+            return None
+        if "checkpoint" in self._ran:
+            return None
+        return self._run_once("checkpoint", self._checkpoint)
 
     def restore(self):
         for sig, prev in self._prev.items():

@@ -146,6 +146,12 @@ class _SeqState:
     t_last_token: float = 0.0
     # decode steps where this sequence stalled on KV-pool pressure
     preemptions: int = 0
+    # abort path (serving tier): absolute perf_counter deadline (0.0 = none)
+    # and terminal status — "finished" until cancel()/deadline expiry flips it
+    # to "cancelled"/"timeout", which makes ``finished`` true so every
+    # dispatch mode's release machinery retires the sequence on the next step
+    deadline: float = 0.0
+    status: str = "finished"
 
     def token_at(self, p: int) -> int:
         if p < len(self.prompt):
@@ -158,6 +164,8 @@ class _SeqState:
 
     @property
     def finished(self) -> bool:
+        if self.status != "finished":
+            return True
         if self.done:
             return True
         if len(self.generated) >= self.max_new_tokens:
@@ -280,13 +288,16 @@ class RaggedInferenceEngine:
     # ------------------------------------------------------------------ put
     def put(self, uid, prompt_tokens, max_new_tokens: int = 64,
             eos_token_id: int | None = None, temperature: float = 0.0,
-            top_k: int = 0, top_p: float = 1.0) -> None:
+            top_k: int = 0, top_p: float = 1.0,
+            deadline_s: float | None = None) -> None:
         """Enqueue a request (reference ``engine_v2.py put()``). Admission into
         the running batch happens inside ``step()`` as slots/budget free up.
         ``temperature``/``top_k``/``top_p`` select per-request sampling
         (0-temperature = greedy), applied inside the compiled step — sampled
         decode works under run-ahead and the fused pipeline with no host
-        round trip (``inference/sampling.py``)."""
+        round trip (``inference/sampling.py``). ``deadline_s`` bounds the
+        request's whole lifetime (queue wait included): past it the sequence
+        is released on the next ``step()`` with span status=timeout."""
         prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -305,11 +316,14 @@ class RaggedInferenceEngine:
                 f"{min(self.cfg.num_blocks - 1, self.cfg.max_blocks_per_seq)} "
                 "are available per sequence — it could never be admitted"
             )
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be positive")
         self._queued.append(_SeqState(
             uid=uid, prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id if eos_token_id is not None else self.eos_token_id,
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p),
+            deadline=(time.perf_counter() + deadline_s) if deadline_s else 0.0,
             t_enqueue=time.perf_counter() if self.telemetry.enabled else 0.0,
         ))
         if self.telemetry.enabled:
@@ -325,6 +339,65 @@ class RaggedInferenceEngine:
         """UIDs of completed requests (public completion signal; the full
         token lists come from ``generate_all`` / the per-uid state)."""
         return set(self._results)
+
+    def get_request(self, uid):
+        """Host descriptor of a request at any lifecycle stage (queued,
+        running, or retired), or None if the uid is unknown. The serving
+        tier's token-delivery loop reads ``generated``/``status`` off it."""
+        seq = self._results.get(uid)
+        if seq is not None:
+            return seq
+        for seq in self._running.values():
+            if seq.uid == uid:
+                return seq
+        for seq in self._queued:
+            if seq.uid == uid:
+                return seq
+        return None
+
+    def cancel(self, uid) -> bool:
+        """Abort a request. The reference engine has no abort path (only a
+        full drain); a serving frontend needs one or a hung client leaks KV
+        pages forever. A queued request is dropped and a running one releases
+        its KV blocks on the next ``step()`` (``_release`` via the normal
+        retirement machinery — under the fused pipeline the release defers
+        until in-flight chunks referencing the sequence reconcile). The
+        request span is emitted with ``status=cancelled``. Returns False if
+        the uid is unknown or already retired."""
+        for seq in self._queued:
+            if seq.uid == uid and seq.status == "finished":
+                seq.status = "cancelled"
+                return True
+        for seq in self._running.values():
+            if seq.uid == uid and seq.status == "finished":
+                seq.status = "cancelled"
+                return True
+        return False
+
+    def _sweep_aborts(self) -> None:
+        """Retire cancelled/deadline-expired sequences (queued AND running)
+        at the top of every step, so an abort can never outlive one step
+        boundary. Queued sequences hold no blocks and retire directly;
+        running ones go through ``_release`` (KV blocks + slot freed) unless
+        the fused pipeline still references them (``refs`` > 0), in which
+        case ``_reconcile_oldest`` releases them as the chunks drain."""
+        now = None
+        for seq in (*self._queued, *self._running.values()):
+            if seq.status == "finished" and seq.deadline:
+                if now is None:
+                    now = time.perf_counter()
+                if now >= seq.deadline:
+                    seq.status = "timeout"
+        aborted = [s for s in self._queued if s.status != "finished"]
+        if aborted:
+            self._queued = [s for s in self._queued if s.status == "finished"]
+            for seq in aborted:
+                self._results[seq.uid] = seq
+                if self.telemetry.enabled:
+                    self._emit_request_span(seq)
+        for seq in list(self._running.values()):
+            if seq.status != "finished" and seq.refs == 0:
+                self._release(seq)
 
     # ------------------------------------------------------------------ step
     def _worst_case_blocks(self, seq: _SeqState) -> int:
@@ -388,10 +461,17 @@ class RaggedInferenceEngine:
                if seq.t_last_token and seq.t_enqueue else 0.0)
         tel.emit_span(
             "inference/request", dur, uid=str(seq.uid),
+            status=seq.status,
             queue_wait_s=queue_wait, ttft_s=ttft,
             decode_latency_s=decode_latency,
             prompt_tokens=len(seq.prompt), new_tokens=n_gen,
             preemptions=seq.preemptions)
+        if seq.status == "cancelled":
+            tel.counter("inference_requests_cancelled_total",
+                        "requests aborted via cancel()").inc()
+        elif seq.status == "timeout":
+            tel.counter("inference_requests_timeout_total",
+                        "requests expired past their deadline").inc()
         tel.counter("inference_requests_total", "requests completed").inc()
         tel.counter("inference_tokens_generated_total",
                     "tokens generated").inc(n_gen)
@@ -1099,6 +1179,9 @@ class RaggedInferenceEngine:
             self.dispatch_count)
 
     def _step_impl(self) -> dict:
+        self._sweep_aborts()
+        if not self.has_work:
+            return {}  # the sweep retired everything schedulable
         if self.cfg.fused_chunk >= 2:
             return self._step_fused()
         # admission FIRST: a newly admitted sequence is in prefill, which

@@ -1,0 +1,48 @@
+"""Production serving tier over the ragged inference engine.
+
+The reference stack splits serving across two repos: DeepSpeed's inference
+v2 ragged engine (the scheduler + kernels) and DeepSpeed-MII on top (the
+frontend, replica routing, and deployment surface). This package is our
+MII-role tier, stdlib-only:
+
+- :mod:`protocol` — request/response dataclasses, validation, SSE framing
+- :mod:`engine_loop` — per-replica background step-loop driver
+  (``put()``/``step()`` pump, per-request token streams, graceful drain)
+- :mod:`router` — least-outstanding-tokens placement + KV-aware admission
+  control + bounded queues (429 backpressure)
+- :mod:`frontend` — ``http.server`` HTTP surface: ``POST /v1/completions``
+  (JSON + SSE), ``GET /healthz``, ``GET /metrics``
+
+See docs/SERVING.md for the architecture walkthrough.
+"""
+
+from deepspeed_tpu.serving.engine_loop import (  # noqa: F401
+    EngineLoop,
+    ReplicaDraining,
+    ReplicaStats,
+    StreamError,
+    TokenStream,
+)
+from deepspeed_tpu.serving.frontend import (  # noqa: F401
+    ServingFrontend,
+    build_server,
+)
+from deepspeed_tpu.serving.protocol import (  # noqa: F401
+    FINISH_CANCELLED,
+    FINISH_LENGTH,
+    FINISH_STOP,
+    FINISH_TIMEOUT,
+    CompletionRequest,
+    CompletionResponse,
+    ProtocolError,
+    decode_sse,
+    encode_sse,
+    sse_done,
+)
+from deepspeed_tpu.serving.router import (  # noqa: F401
+    Draining,
+    Overloaded,
+    ReplicaRouter,
+    RouterConfig,
+    plan_placement,
+)

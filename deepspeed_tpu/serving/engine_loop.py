@@ -1,0 +1,333 @@
+"""Per-replica step-loop driver: the thread that owns a RaggedInferenceEngine.
+
+The ragged engine (``inference/ragged.py``) is a pull-driven scheduler —
+someone must pump ``put()``/``step()`` — and it is not thread-safe. The
+``EngineLoop`` makes it servable: one background thread owns the engine
+outright, requests arrive through a bounded priority inbox, emitted tokens
+are delivered to per-request ``TokenStream`` queues as each step completes,
+and graceful drain (stop admitting, finish inflight, exit) hooks into the
+same SIGTERM path as ``elasticity.PreemptionHandler``.
+
+Cross-thread surface, by design minimal:
+
+- ``submit()``/``cancel()`` mutate only the inbox under its lock and set a
+  wake event; the loop thread does every ``engine.*`` call.
+- ``stats()`` combines the loop thread's last published engine snapshot
+  (an immutable tuple swap — no lock on the hot path) with the live inbox
+  counters, giving the router a conservative view for placement/admission.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import queue
+import threading
+from dataclasses import dataclass
+
+from deepspeed_tpu.serving.protocol import (
+    FINISH_CANCELLED,
+    FINISH_LENGTH,
+    FINISH_STOP,
+    CompletionRequest,
+)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class StreamError(RuntimeError):
+    """The request failed server-side (validation or engine error)."""
+
+
+class ReplicaDraining(RuntimeError):
+    """submit() after begin_drain(): the replica no longer admits work."""
+
+
+class TokenStream:
+    """Consumer handle for one request's token stream.
+
+    The loop thread pushes ``("token", id)`` events and exactly one terminal
+    ``("done", finish_reason)`` or ``("error", message)``; consumers iterate
+    ``events()`` (SSE path) or block on ``collect()`` (non-streaming path).
+    """
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self.finish_reason: str | None = None
+        self.error: str | None = None
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+
+    # ---------------------------------------------- producer (loop thread)
+    def _push(self, token: int) -> None:
+        self._q.put(("token", int(token)))
+
+    def _finish(self, reason: str) -> None:
+        self.finish_reason = reason
+        self._q.put(("done", reason))
+
+    def _fail(self, message: str) -> None:
+        self.error = message
+        self._q.put(("error", message))
+
+    # ---------------------------------------------------------- consumer
+    def events(self, timeout: float | None = None):
+        """Yield ``("token", id)`` events until the terminal ``("done", _)``
+        / ``("error", _)`` event, which is yielded last. ``timeout`` bounds
+        the wait for EACH event (TimeoutError past it)."""
+        while True:
+            try:
+                kind, value = self._q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"request {self.request_id}: no event within {timeout}s"
+                ) from None
+            yield kind, value
+            if kind in ("done", "error"):
+                return
+
+    def collect(self, timeout: float | None = None) -> tuple[list[int], str]:
+        """Block until terminal; returns ``(tokens, finish_reason)`` or
+        raises StreamError / TimeoutError."""
+        tokens: list[int] = []
+        for kind, value in self.events(timeout=timeout):
+            if kind == "token":
+                tokens.append(value)
+            elif kind == "error":
+                raise StreamError(value)
+            else:
+                return tokens, value
+        raise StreamError(f"request {self.request_id}: stream ended abruptly")
+
+
+@dataclass(frozen=True)
+class ReplicaStats:
+    """Router-facing snapshot of one replica (conservative: inbox work not
+    yet visible to the engine counts as queued/pending)."""
+
+    name: str
+    alive: bool
+    draining: bool
+    queued: int               # engine queue + undrained inbox
+    inflight: int             # admitted (running) sequences
+    outstanding_tokens: int   # remaining prompt+decode tokens across all work
+    free_blocks: int          # unreserved free KV blocks in the engine pool
+    pending_blocks: int       # worst-case blocks promised to inbox requests
+    block_size: int
+    usable_blocks: int        # pool size minus the scratch block
+    max_request_blocks: int   # per-request block ceiling (put() rejects past it)
+    max_request_tokens: int   # engine max_seq_len
+
+    def worst_blocks(self, total_tokens: int) -> int:
+        return -(-total_tokens // self.block_size)
+
+
+class _Open:
+    """Loop-thread bookkeeping for one in-engine request."""
+
+    __slots__ = ("stream", "delivered")
+
+    def __init__(self, stream: TokenStream):
+        self.stream = stream
+        self.delivered = 0
+
+
+class EngineLoop:
+    """Background driver for one RaggedInferenceEngine replica."""
+
+    def __init__(self, engine, name: str = "replica-0",
+                 idle_wait_s: float = 0.002):
+        self._engine = engine
+        self.name = name
+        self._idle_wait_s = float(idle_wait_s)
+        self._lock = threading.Lock()
+        self._inbox: list = []       # heap of (priority, seqno, req, stream)
+        self._seqno = itertools.count()
+        self._cancel_ids: set[str] = set()
+        self._pending_blocks = 0
+        self._pending_tokens = 0
+        self._open: dict[str, _Open] = {}
+        self._wake = threading.Event()
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        # alive = "has not died": true from construction so a cold (not yet
+        # started) loop can accumulate queued work, false once _run exits
+        self._alive = True
+        self.error: str | None = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"engine-loop-{name}", daemon=True)
+        cfg = engine.cfg
+        self._block_size = cfg.block_size
+        self._usable_blocks = cfg.num_blocks - 1
+        self._max_request_blocks = min(cfg.num_blocks - 1,
+                                       cfg.max_blocks_per_seq)
+        self._max_request_tokens = cfg.max_seq_len
+        # (queued, inflight, outstanding_tokens, free_unreserved_blocks):
+        # published by the loop thread as an atomic tuple swap
+        self._engine_stats = (0, 0, 0, engine.allocator.free_blocks)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "EngineLoop":
+        self._thread.start()
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admitting; the loop finishes inflight work then exits.
+        Non-blocking and signal-safe (flag flips only) — registrable as an
+        ``immediate`` PreemptionHandler callback."""
+        self._draining.set()
+        self._wake.set()
+
+    def join(self, timeout: float | None = None) -> bool:
+        """Wait for the loop to exit (after ``begin_drain``)."""
+        if not self._thread.is_alive():
+            return True
+        return self._stopped.wait(timeout)
+
+    def close(self, timeout: float | None = 30.0) -> bool:
+        self.begin_drain()
+        return self.join(timeout)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    # -------------------------------------------------------------- submit
+    def _worst_blocks(self, req: CompletionRequest) -> int:
+        return -(-req.total_tokens // self._block_size)
+
+    def submit(self, req: CompletionRequest) -> TokenStream:
+        """Enqueue a request; returns its TokenStream immediately. The
+        actual ``engine.put()`` happens on the loop thread (priority order,
+        lower first). Raises ReplicaDraining after ``begin_drain``."""
+        if self._draining.is_set():
+            raise ReplicaDraining(f"{self.name} is draining")
+        stream = TokenStream(req.request_id)
+        with self._lock:
+            heapq.heappush(
+                self._inbox, (req.priority, next(self._seqno), req, stream))
+            self._pending_blocks += self._worst_blocks(req)
+            self._pending_tokens += req.total_tokens
+        self._wake.set()
+        return stream
+
+    def cancel(self, request_id: str) -> None:
+        """Abort a request wherever it is (inbox, queued, or running); its
+        stream terminates with finish_reason=cancelled and KV blocks free on
+        the loop's next step."""
+        with self._lock:
+            self._cancel_ids.add(request_id)
+        self._wake.set()
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> ReplicaStats:
+        queued, inflight, outstanding, free = self._engine_stats
+        with self._lock:
+            n_inbox = len(self._inbox)
+            pending_blocks = self._pending_blocks
+            pending_tokens = self._pending_tokens
+        return ReplicaStats(
+            name=self.name, alive=self._alive,
+            draining=self._draining.is_set(),
+            queued=queued + n_inbox, inflight=inflight,
+            outstanding_tokens=outstanding + pending_tokens,
+            free_blocks=free, pending_blocks=pending_blocks,
+            block_size=self._block_size, usable_blocks=self._usable_blocks,
+            max_request_blocks=self._max_request_blocks,
+            max_request_tokens=self._max_request_tokens)
+
+    # ------------------------------------------------------- loop internals
+    def _drain_inbox(self) -> None:
+        eng = self._engine
+        with self._lock:
+            items = [heapq.heappop(self._inbox) for _ in range(len(self._inbox))]
+            cancels = self._cancel_ids
+            self._cancel_ids = set()
+        for _, _, req, stream in items:
+            rid = req.request_id
+            if rid in cancels:
+                cancels.discard(rid)
+                stream._finish(FINISH_CANCELLED)
+            else:
+                try:
+                    eng.put(rid, req.prompt, max_new_tokens=req.max_tokens,
+                            eos_token_id=req.eos_token_id,
+                            temperature=req.temperature, top_k=req.top_k,
+                            top_p=req.top_p, deadline_s=req.deadline_s)
+                    self._open[rid] = _Open(stream)
+                except ValueError as e:
+                    stream._fail(str(e))
+            with self._lock:
+                self._pending_blocks -= self._worst_blocks(req)
+                self._pending_tokens -= req.total_tokens
+        for rid in cancels:
+            eng.cancel(rid)  # unknown/already-retired ids are a no-op
+
+    def _finish_reason(self, seq) -> str:
+        if seq.status != "finished":
+            return seq.status  # cancelled | timeout
+        if (seq.eos_token_id is not None and seq.generated
+                and seq.generated[-1] == seq.eos_token_id):
+            return FINISH_STOP
+        return FINISH_LENGTH
+
+    def _deliver(self) -> None:
+        eng = self._engine
+        for rid in list(self._open):
+            op = self._open[rid]
+            seq = eng.get_request(rid)
+            if seq is None:  # pragma: no cover - put() succeeded, must exist
+                op.stream._fail(f"request {rid} lost by engine")
+                del self._open[rid]
+                continue
+            gen = seq.generated
+            while op.delivered < len(gen):
+                op.stream._push(gen[op.delivered])
+                op.delivered += 1
+            if rid in eng._results:
+                op.stream._finish(self._finish_reason(seq))
+                del self._open[rid]
+
+    def _publish_stats(self) -> None:
+        eng = self._engine
+        outstanding = 0
+        for s in eng._queued:
+            outstanding += len(s.prompt) + s.max_new_tokens
+        for s in eng._running.values():
+            outstanding += max(0, len(s.prompt) - s.pos) + \
+                (s.max_new_tokens - len(s.generated))
+        self._engine_stats = (
+            len(eng._queued), len(eng._running), outstanding,
+            eng.allocator.free_blocks - eng._reserved)
+
+    def _run(self) -> None:
+        eng = self._engine
+        try:
+            while True:
+                self._drain_inbox()
+                if eng.has_work:
+                    eng.step()
+                    self._deliver()
+                    self._publish_stats()
+                    continue
+                self._deliver()
+                self._publish_stats()
+                with self._lock:
+                    idle = not self._inbox and not self._cancel_ids
+                if idle and self._draining.is_set():
+                    break
+                self._wake.wait(self._idle_wait_s)
+                self._wake.clear()
+        except Exception as e:  # noqa: BLE001 - the loop IS the failure domain
+            self.error = f"{type(e).__name__}: {e}"
+            log_dist(f"engine loop {self.name} died: {self.error}", ranks=[0])
+            for op in self._open.values():
+                op.stream._fail(self.error)
+            self._open.clear()
+            with self._lock:
+                items, self._inbox = self._inbox, []
+                self._pending_blocks = self._pending_tokens = 0
+            for _, _, _, stream in items:
+                stream._fail(self.error)
+        finally:
+            self._alive = False
+            self._draining.set()  # a dead replica must not admit
+            self._stopped.set()

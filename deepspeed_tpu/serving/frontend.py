@@ -1,0 +1,233 @@
+"""HTTP frontend over the replica router (stdlib ``http.server`` only).
+
+Endpoints:
+
+- ``POST /v1/completions`` — OpenAI-completions-shaped JSON body (token-id
+  prompts; see ``protocol.py``). Non-streaming returns one JSON
+  ``CompletionResponse``; ``"stream": true`` returns ``text/event-stream``
+  with one frame per token, a final frame carrying the full response, then
+  the ``[DONE]`` terminator. Backpressure surfaces as 429 + ``Retry-After``
+  (admission control) and 503 (draining); client disconnect mid-stream
+  cancels the request so its KV blocks free on the next engine step.
+- ``GET /healthz`` — ``{"status": ready|overloaded|draining}``; 200 when
+  servable, 503 while draining (load-balancer semantics: stop sending).
+- ``GET /metrics`` — Prometheus text exposition straight from the PR-1
+  telemetry registry (serving gauges refreshed at scrape time). Serving a
+  scrape endpoint here does not flip telemetry on: with telemetry disabled
+  the page renders whatever the registry holds (typically nothing) and the
+  serving hot path still emits zero metrics.
+
+``ThreadingHTTPServer`` gives a thread per connection, which is what SSE
+needs: a streaming response parks its thread on the request's TokenStream
+while the single engine-loop thread keeps stepping.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepspeed_tpu.serving.engine_loop import StreamError
+from deepspeed_tpu.serving.protocol import (
+    CompletionRequest,
+    CompletionResponse,
+    ProtocolError,
+    encode_sse,
+    sse_done,
+)
+from deepspeed_tpu.serving.router import Draining, Overloaded, ReplicaRouter
+from deepspeed_tpu.telemetry import get_telemetry
+from deepspeed_tpu.telemetry.exporters import PrometheusExporter
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ServingFrontend:
+    """Bind + serve the HTTP surface for one ReplicaRouter."""
+
+    def __init__(self, router: ReplicaRouter, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout_s: float = 300.0):
+        self.router = router
+        self.request_timeout_s = float(request_timeout_s)
+        handler = _make_handler(self)
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="serving-frontend",
+            daemon=True)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "ServingFrontend":
+        self._thread.start()
+        log_dist(f"serving frontend listening on {self.host}:{self.port}",
+                 ranks=[0])
+        return self
+
+    def install_preemption_handler(self, handler) -> None:
+        """Register drain on an ``elasticity.PreemptionHandler``: SIGTERM →
+        stop admitting immediately (flag flips only, signal-safe); inflight
+        requests finish and the engine loops exit on their own threads."""
+        handler.register("serving-drain", self.router.begin_drain,
+                         immediate=True)
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Stop admitting, wait for inflight work, stop the HTTP listener."""
+        ok = self.router.drain(timeout)
+        self.close()
+        return ok
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _make_handler(frontend: ServingFrontend):
+    router = frontend.router
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: A003 - http.server API
+            pass  # request logging goes through telemetry, not stderr
+
+        # ------------------------------------------------------- helpers
+        def _send_json(self, code: int, payload: dict,
+                       headers: dict | None = None) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_error_json(self, code: int, message: str,
+                             headers: dict | None = None) -> None:
+            self._send_json(code, {"error": {"message": message,
+                                             "code": code}}, headers)
+
+        # ----------------------------------------------------------- GET
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path == "/healthz":
+                state = router.state()
+                self._send_json(503 if state == "draining" else 200,
+                                {"status": state})
+            elif self.path == "/metrics":
+                router.refresh_metrics()
+                body = get_telemetry().registry.render_prometheus()
+                body = body.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 PrometheusExporter.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._send_error_json(404, f"no route for {self.path}")
+
+        # ---------------------------------------------------------- POST
+        def do_POST(self):  # noqa: N802 - http.server API
+            if self.path != "/v1/completions":
+                self._send_error_json(404, f"no route for {self.path}")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                self._send_error_json(400, "request body is not valid JSON")
+                return
+            try:
+                req = CompletionRequest.from_json(body)
+                stream = router.submit(req)
+            except ProtocolError as e:
+                self._send_error_json(400, str(e))
+                return
+            except Overloaded as e:
+                self._send_error_json(
+                    429, str(e),
+                    headers={"Retry-After": f"{e.retry_after_s:g}"})
+                return
+            except Draining as e:
+                self._send_error_json(503, str(e))
+                return
+            try:
+                if req.stream:
+                    self._stream_response(req, stream)
+                else:
+                    self._full_response(req, stream)
+            finally:
+                router.release(req.request_id)
+
+        def _full_response(self, req, stream) -> None:
+            try:
+                tokens, reason = stream.collect(
+                    timeout=frontend.request_timeout_s)
+            except (StreamError, TimeoutError) as e:
+                if isinstance(e, TimeoutError):
+                    router.cancel(req.request_id)
+                self._send_error_json(400, str(e))
+                return
+            resp = CompletionResponse(
+                request_id=req.request_id, tokens=tokens,
+                finish_reason=reason, prompt_tokens=len(req.prompt))
+            self._send_json(200, resp.to_json())
+
+        def _stream_response(self, req, stream) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            # no Content-Length for a live stream: HTTP/1.1 needs an
+            # explicit close to delimit the body
+            self.send_header("Connection", "close")
+            self.end_headers()
+            tokens: list[int] = []
+            try:
+                for kind, value in stream.events(
+                        timeout=frontend.request_timeout_s):
+                    if kind == "token":
+                        self.wfile.write(encode_sse({
+                            "id": req.request_id, "token": value,
+                            "index": len(tokens)}))
+                        self.wfile.flush()
+                        tokens.append(value)
+                    elif kind == "error":
+                        self.wfile.write(encode_sse(
+                            {"id": req.request_id, "error": value},
+                            event="error"))
+                        break
+                    else:  # done
+                        resp = CompletionResponse(
+                            request_id=req.request_id, tokens=tokens,
+                            finish_reason=value,
+                            prompt_tokens=len(req.prompt))
+                        self.wfile.write(encode_sse(resp.to_json()))
+                        self.wfile.write(sse_done())
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionError, TimeoutError, OSError):
+                # client went away (or stalled past the deadline): abort the
+                # request so its KV blocks free on the next engine step
+                router.cancel(req.request_id)
+                self.close_connection = True
+
+    return Handler
+
+
+def build_server(engines, host: str = "127.0.0.1", port: int = 0,
+                 router_cfg=None, start: bool = True):
+    """Convenience: EngineLoop-wrap ``engines``, route, bind, and start.
+
+    Returns ``(frontend, router, loops)``; pass ``start=False`` to leave
+    the loops and listener cold (tests use this for determinism).
+    """
+    from deepspeed_tpu.serving.engine_loop import EngineLoop
+
+    loops = [EngineLoop(e, name=f"replica-{i}") for i, e in enumerate(engines)]
+    router = ReplicaRouter(loops, router_cfg)
+    frontend = ServingFrontend(router, host=host, port=port)
+    if start:
+        for lp in loops:
+            lp.start()
+        frontend.start()
+    return frontend, router, loops

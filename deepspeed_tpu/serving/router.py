@@ -1,0 +1,199 @@
+"""Multi-replica request router: placement, admission control, backpressure.
+
+The router is the MII-frontend role over our engine tier: it looks at each
+replica's ``ReplicaStats`` snapshot and decides, per request, between
+
+- **admit now** — some replica has enough unreserved KV blocks for the
+  request's worst case (``ceil(total_tokens / block_size)`` on top of what
+  its inbox already promised). Ties break to the replica with the fewest
+  outstanding tokens (least-outstanding-tokens placement — outstanding
+  tokens, not request count, is what predicts queueing delay under ragged
+  batching).
+- **queue** — no replica has free blocks, but some replica's bounded queue
+  (``max_queue_tokens`` worth of outstanding work) still has room; place
+  there and let the engine's own conservative admission pace it.
+- **reject** — every live replica is past its queue bound. The caller gets
+  ``Overloaded`` carrying a retry-after hint (HTTP 429 upstream). Shedding
+  at the door beats timing out inside: an admitted request holds its KV
+  reservation while it waits.
+
+``plan_placement`` is a pure function of the stats snapshot so the admission
+math is unit-testable without sockets or threads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from deepspeed_tpu.serving.engine_loop import (
+    EngineLoop,
+    ReplicaDraining,
+    ReplicaStats,
+    TokenStream,
+)
+from deepspeed_tpu.serving.protocol import CompletionRequest, ProtocolError
+from deepspeed_tpu.telemetry import get_telemetry
+
+
+class Overloaded(RuntimeError):
+    """Every replica is past its queue bound (maps to HTTP 429)."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class Draining(RuntimeError):
+    """The whole router is draining (maps to HTTP 503)."""
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    # per-replica bound on outstanding (queued + inflight) tokens before the
+    # router sheds load; sized so queue wait stays ~bounded at one replica's
+    # worst-case step throughput
+    max_queue_tokens: int = 4096
+    # Retry-After hint handed to rejected clients
+    retry_after_s: float = 1.0
+
+
+def plan_placement(
+    stats: list[ReplicaStats], total_tokens: int, cfg: RouterConfig,
+) -> tuple[int | None, str]:
+    """Pure admission/placement decision over a stats snapshot.
+
+    Returns ``(replica_index, verdict)`` where verdict is one of
+    ``"admit"`` (free KV blocks now), ``"queue"`` (fits under the queue
+    bound), ``"draining"`` / ``"overloaded"`` (index is None).
+    """
+    live = [(i, s) for i, s in enumerate(stats) if s.alive and not s.draining]
+    if not live:
+        return None, "draining"
+    need = {s.name: s.worst_blocks(total_tokens) for _, s in live}
+    fits_now = [
+        (i, s) for i, s in live
+        if need[s.name] <= s.free_blocks - s.pending_blocks
+        and s.outstanding_tokens + total_tokens <= cfg.max_queue_tokens
+    ]
+    if fits_now:
+        i, _ = min(fits_now, key=lambda t: t[1].outstanding_tokens)
+        return i, "admit"
+    can_queue = [
+        (i, s) for i, s in live
+        if s.outstanding_tokens + total_tokens <= cfg.max_queue_tokens
+    ]
+    if can_queue:
+        i, _ = min(can_queue, key=lambda t: t[1].outstanding_tokens)
+        return i, "queue"
+    return None, "overloaded"
+
+
+class ReplicaRouter:
+    """Route requests across EngineLoop replicas; own drain + metrics."""
+
+    def __init__(self, replicas: list[EngineLoop],
+                 cfg: RouterConfig | None = None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self.cfg = cfg or RouterConfig()
+        self._placements: dict[str, EngineLoop] = {}
+        self._draining = False
+
+    # ------------------------------------------------------------- submit
+    def submit(self, req: CompletionRequest) -> TokenStream:
+        """Place + enqueue one request; returns its TokenStream. Raises
+        Draining / Overloaded / ProtocolError (request can never fit)."""
+        if self._draining:
+            raise Draining("server is draining")
+        stats = [r.stats() for r in self.replicas]
+        cap_tokens = max(s.max_request_tokens for s in stats)
+        cap_blocks = max(s.max_request_blocks for s in stats)
+        if (req.total_tokens > cap_tokens
+                or stats[0].worst_blocks(req.total_tokens) > cap_blocks):
+            raise ProtocolError(
+                f"prompt+max_tokens = {req.total_tokens} exceeds the "
+                f"serveable maximum ({cap_tokens} tokens)")
+        idx, verdict = plan_placement(stats, req.total_tokens, self.cfg)
+        tel = get_telemetry()
+        if idx is None:
+            if verdict == "draining":
+                raise Draining("server is draining")
+            if tel.enabled:
+                tel.counter("serving_requests_rejected_total").inc()
+            raise Overloaded(
+                f"all {len(self.replicas)} replicas past "
+                f"max_queue_tokens={self.cfg.max_queue_tokens}",
+                retry_after_s=self.cfg.retry_after_s)
+        replica = self.replicas[idx]
+        try:
+            stream = replica.submit(req)
+        except ReplicaDraining:
+            raise Draining("server is draining") from None
+        self._placements[req.request_id] = replica
+        if tel.enabled:
+            tel.counter("serving_requests_admitted_total").inc()
+            if verdict == "queue":
+                tel.counter("serving_requests_queued_total").inc()
+        return stream
+
+    def cancel(self, request_id: str) -> None:
+        replica = self._placements.pop(request_id, None)
+        if replica is not None:
+            replica.cancel(request_id)
+            tel = get_telemetry()
+            if tel.enabled:
+                tel.counter("serving_requests_cancelled_total").inc()
+
+    def release(self, request_id: str) -> None:
+        """Forget a finished request's placement (frontend calls this after
+        the terminal event so the map does not grow without bound)."""
+        self._placements.pop(request_id, None)
+
+    # -------------------------------------------------------------- state
+    def state(self) -> str:
+        """Healthcheck verdict: ready | overloaded | draining."""
+        if self._draining or not any(
+                r.stats().alive and not r.draining for r in self.replicas):
+            return "draining"
+        stats = [r.stats() for r in self.replicas]
+        idx, verdict = plan_placement(stats, 1, self.cfg)
+        del idx
+        return "overloaded" if verdict == "overloaded" else "ready"
+
+    def begin_drain(self) -> None:
+        """Stop admitting everywhere; non-blocking and signal-safe — the
+        frontend registers this as an immediate PreemptionHandler hook."""
+        self._draining = True
+        for r in self.replicas:
+            r.begin_drain()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """begin_drain + wait for every replica loop to finish inflight
+        work and exit. True if all replicas stopped within the timeout."""
+        self.begin_drain()
+        ok = True
+        for r in self.replicas:
+            ok = r.join(timeout) and ok
+        return ok
+
+    # ------------------------------------------------------------ metrics
+    def refresh_metrics(self) -> None:
+        """Write current serving gauges into the telemetry registry (called
+        at /metrics scrape time; no-op while telemetry is disabled)."""
+        tel = get_telemetry()
+        if not tel.enabled:
+            return
+        stats = [r.stats() for r in self.replicas]
+        tel.gauge("serving_replicas").set(len(stats))
+        tel.gauge("serving_replicas_live").set(
+            sum(1 for s in stats if s.alive and not s.draining))
+        tel.gauge("serving_queue_depth").set(sum(s.queued for s in stats))
+        tel.gauge("serving_inflight").set(sum(s.inflight for s in stats))
+        tel.gauge("serving_outstanding_tokens").set(
+            sum(s.outstanding_tokens for s in stats))
+        tel.gauge("serving_kv_free_blocks").set(
+            sum(s.free_blocks for s in stats))
+        tel.gauge("serving_kv_pending_blocks").set(
+            sum(s.pending_blocks for s in stats))
+        tel.gauge("serving_draining").set(1.0 if self._draining else 0.0)

@@ -97,3 +97,31 @@ class TestPreemptionHandler:
             assert handler.checkpoint_if_needed() is None  # at most once
         finally:
             handler.restore()
+
+    def test_drain_callbacks_engine_free(self):
+        """Serving-style registration: no training engine, immediate hooks
+        fire inside the signal handler, deferred hooks via drain(), each at
+        most once."""
+        handler = PreemptionHandler(signals=(signal.SIGTERM,))
+        fired = []
+        handler.register("stop-admission", lambda: fired.append("now") or "ok",
+                         immediate=True)
+        handler.register("flush", lambda: fired.append("later") or 7)
+        with pytest.raises(ValueError, match="already registered"):
+            handler.register("flush", lambda: None)
+        try:
+            assert handler.drain() == {}  # no signal yet -> no-op
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert handler.should_stop and handler.stop_event.is_set()
+            assert fired == ["now"]  # immediate hook ran in the handler
+            results = handler.drain()
+            assert fired == ["now", "later"]
+            assert results == {"stop-admission": "ok", "flush": 7}
+            assert handler.drain() == results  # at most once per hook
+            assert handler.checkpoint_if_needed() is None  # engine-free
+        finally:
+            handler.restore()
+
+    def test_engine_requires_save_dir(self):
+        with pytest.raises(ValueError, match="save_dir"):
+            PreemptionHandler(engine=object())
