@@ -678,18 +678,28 @@ def serving_bench_main():
         fused, depth, max_prompt = 4, 2, 64
     n_req = int(e.get("BENCH_SERVING_REQUESTS", n_req))
     rate = float(e.get("BENCH_SERVING_RATE", rate))  # arrivals per second
+    # shared-prefix workload (--shared-prefix-tokens): every prompt opens
+    # with the same N tokens (system prompt / few-shot template traffic) and
+    # the engine runs with the block-level prefix cache on — after the first
+    # request retires, later prefills splice the shared blocks instead of
+    # recomputing them
+    shared_prefix = int(e.get("BENCH_SERVING_SHARED_PREFIX", 0))
 
     tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "BENCH_serving_telemetry.jsonl"))
     telemetry.configure(enabled=True, jsonl_path=tel_path)
 
+    if shared_prefix >= max_prompt:
+        raise SystemExit(f"BENCH_SERVING_SHARED_PREFIX={shared_prefix} must "
+                         f"be < the max prompt length ({max_prompt})")
     mbs = -(-(max_prompt + max_new) // block)
     rcfg = RaggedConfig(
         max_tokens_per_step=budget, max_seqs=max_seqs, block_size=block,
         num_blocks=max_seqs * mbs + 1, max_blocks_per_seq=mbs,
         decode_run_ahead=ahead, prefill_tile=tile,
-        fused_chunk=fused, pipeline_depth=depth)
+        fused_chunk=fused, pipeline_depth=depth,
+        enable_prefix_cache=shared_prefix > 0)
     engine = RaggedInferenceEngine(
         model=lambda ctx: llama.build(model_cfg, ctx=ctx),
         ragged_config=rcfg, seed=0)
@@ -700,9 +710,12 @@ def serving_bench_main():
             max_queue_tokens=int(e.get("BENCH_SERVING_QUEUE_TOKENS", 2048))))
 
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, model_cfg.vocab_size,
-                            (int(prompt_lens[i % len(prompt_lens)]),),
-                            dtype=np.int32).tolist() for i in range(n_req)]
+    prefix = rng.integers(0, model_cfg.vocab_size, (shared_prefix,),
+                          dtype=np.int32).tolist()
+    prompts = [prefix + rng.integers(
+        0, model_cfg.vocab_size,
+        (max(1, int(prompt_lens[i % len(prompt_lens)]) - shared_prefix),),
+        dtype=np.int32).tolist() for i in range(n_req)]
     rng.shuffle(prompts)
     # open-loop schedule: exponential inter-arrival gaps, fixed before the
     # clock starts so client-side jitter can't thin the offered load
@@ -774,11 +787,22 @@ def serving_bench_main():
     gaps_s = [g for r in done
               for g in np.diff(r["token_times"]).tolist()]
     goodput = sum(r["useful"] for r in done) / wall if wall > 0 else 0.0
+    decided = engine.prefix_hits + engine.prefix_misses
+    cache_stats = {
+        "serving_shared_prefix_tokens": shared_prefix,
+        "serving_prefix_cache_hits": engine.prefix_hits,
+        "serving_prefix_cache_hit_rate":
+            round(engine.prefix_hits / decided, 4) if decided else 0.0,
+        "serving_prefill_tokens_saved": engine.prefix_tokens_reused,
+        "serving_prefix_cache_evictions": engine.allocator.evictions,
+        "serving_tokens_scheduled": engine.tokens_scheduled,
+    } if shared_prefix > 0 else {}
     telemetry.TELEMETRY.close()
     print(json.dumps({
         "metric": "serving_frontend_poisson",
         "serving_requests": n_req,
         "serving_rate_rps": rate,
+        **cache_stats,
         "serving_completed": len(done),
         "serving_rejected": rejected,
         "serving_rejected_rate": round(rejected / max(1, len(results)), 4),
@@ -1077,6 +1101,15 @@ def main():
             print(f"bench: unknown --mode {mode or '(missing)'}; "
                   "supported: serving", file=sys.stderr)
             return 2
+        if "--shared-prefix-tokens" in sys.argv:
+            # shared-prompt workload: prompts share an N-token prefix and
+            # the engine serves with the block-level prefix cache enabled
+            val = sys.argv[sys.argv.index("--shared-prefix-tokens") + 1:][:1]
+            if not val or not val[0].isdigit():
+                print("bench: --shared-prefix-tokens needs an integer",
+                      file=sys.stderr)
+                return 2
+            os.environ["BENCH_SERVING_SHARED_PREFIX"] = val[0]
         result, err = run_serving_subprocess()
         if result is None:
             print(f"serving bench failed:\n{err}", file=sys.stderr)

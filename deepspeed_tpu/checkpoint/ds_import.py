@@ -74,9 +74,15 @@ def _optim_files(ckpt_dir: str) -> list[str]:
     if not files:
         raise FileNotFoundError(f"no *_optim_states.pt under {ckpt_dir!r}")
 
+    unparsed = []
+
     def dp_rank(p):
         m = re.search(r"zero_pp_rank_(\d+)_mp_rank_(\d+)", os.path.basename(p))
         if m is None:
+            # stage-1/2 single-file layouts (mp_rank_00_optim_states.pt)
+            # carry no dp rank in the name; ONE such file is fine, but two+
+            # would silently merge in glob order — refuse instead
+            unparsed.append(os.path.basename(p))
             return (0, 0)
         if m.group(2) != "00":
             raise NotImplementedError(
@@ -84,7 +90,14 @@ def _optim_files(ckpt_dir: str) -> list[str]:
                 f"({os.path.basename(p)})")
         return (int(m.group(1)), 0)
 
-    return sorted(files, key=dp_rank)
+    out = sorted(files, key=dp_rank)
+    if len(unparsed) > 1:
+        raise ValueError(
+            f"{len(unparsed)} optim-state files carry no parseable "
+            f"zero_pp_rank_N dp rank ({sorted(unparsed)}); dp-rank order is "
+            "ambiguous and concatenating them in glob order would corrupt "
+            "the merged partitions")
+    return out
 
 
 def _split_flat(flat: np.ndarray, shapes: dict) -> dict:
@@ -137,12 +150,21 @@ def _merge_stage3(rank_groups: list[list[np.ndarray]],
     return merged
 
 
-def read_zero_checkpoint(ckpt_dir: str):
+def read_zero_checkpoint(ckpt_dir: str, allow_missing_moments: bool = False):
     """Reconstruct a DeepSpeed ZeRO checkpoint directory.
 
     Returns ``(params, moments, meta)``: ``params`` {torch name: fp32
     ndarray}; ``moments`` {"exp_avg": {...}, "exp_avg_sq": {...}} in the
-    same naming; ``meta`` {"step", "zero_stage", "world_size"}.
+    same naming; ``meta`` {"step", "zero_stage", "world_size",
+    "missing_moments"}.
+
+    A checkpoint whose ``base_optimizer_state`` lacks ``exp_avg`` /
+    ``exp_avg_sq`` (optimizer state stripped, or a non-Adam optimizer)
+    raises by default: zero-filled moments silently reset Adam's bias
+    correction and second-moment scaling, which degrades a resumed run.
+    Pass ``allow_missing_moments=True`` to substitute zeros deliberately —
+    the warning still fires and ``meta["missing_moments"]`` lists the
+    affected (dp_rank, group) pairs.
     """
     model_sd = _torch_load(_find_model_states(ckpt_dir))
     param_shapes = model_sd.get("param_shapes")
@@ -158,6 +180,7 @@ def read_zero_checkpoint(ckpt_dir: str):
     rank_v: list[list[np.ndarray]] = []
     step = 0
     stage = 0
+    missing_moments: list[tuple[int, int]] = []  # (dp_rank, group)
     for path in _optim_files(ckpt_dir):
         sd = _torch_load(path)
         osd = sd.get("optimizer_state_dict", sd)
@@ -188,14 +211,28 @@ def read_zero_checkpoint(ckpt_dir: str):
             st = states.get(g, {}) if isinstance(states, dict) else {}
             if not isinstance(st, dict):
                 st = {}
+            if "exp_avg" not in st or "exp_avg_sq" not in st:
+                missing_moments.append((len(rank_fp32) - 1, g))
             ms.append(_np(st["exp_avg"]).reshape(-1) if "exp_avg" in st
-                      else np.zeros_like(rank_fp32[-1][g]))  # lazy default
+                      else np.zeros_like(rank_fp32[-1][g]))
             vs.append(_np(st["exp_avg_sq"]).reshape(-1) if "exp_avg_sq" in st
-                      else np.zeros_like(rank_fp32[-1][g]))  # lazy default
+                      else np.zeros_like(rank_fp32[-1][g]))
             if "step" in st:
                 step = int(_np(st["step"]).reshape(-1)[0])
         rank_m.append(ms)
         rank_v.append(vs)
+
+    if missing_moments:
+        msg = (f"{len(missing_moments)} (dp_rank, group) partitions have no "
+               "exp_avg/exp_avg_sq Adam moments "
+               f"({missing_moments[:8]}{'...' if len(missing_moments) > 8 else ''}); "
+               "zero-filling them resets Adam's moment estimates on resume")
+        if not allow_missing_moments:
+            raise ValueError(
+                msg + " — pass allow_missing_moments=True to zero-fill "
+                "deliberately (e.g. for inference-only imports)")
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning("ds_import: %s", msg)
 
     merge = _merge_stage3 if stage == 3 else _merge_stage12
     params: dict = {}
@@ -205,7 +242,8 @@ def read_zero_checkpoint(ckpt_dir: str):
                      (rank_v, exp_avg_sq)):
         for g, flat in enumerate(merge(src, param_shapes)):
             dst.update(_split_flat(flat, param_shapes[g]))
-    meta = {"step": step, "zero_stage": stage, "world_size": len(rank_fp32)}
+    meta = {"step": step, "zero_stage": stage, "world_size": len(rank_fp32),
+            "missing_moments": missing_moments}
     return params, {"exp_avg": exp_avg, "exp_avg_sq": exp_avg_sq}, meta
 
 
@@ -250,7 +288,8 @@ def to_repo_params(named: dict, family: str, cfg) -> dict:
 
 
 def import_checkpoint(ckpt_dir: str, family: str, cfg,
-                      out_dir: str | None = None):
+                      out_dir: str | None = None,
+                      allow_missing_moments: bool = False):
     """DeepSpeed checkpoint dir -> (params pytree, moments pytrees, meta).
 
     ``moments`` are param-congruent ``{"mu": ..., "nu": ...}`` pytrees (the
@@ -259,7 +298,8 @@ def import_checkpoint(ckpt_dir: str, family: str, cfg,
     manifest, loadable by ``Engine.load_checkpoint(out_dir, tag="imported")``
     with ``load_optimizer_states=False``.
     """
-    named, moments, meta = read_zero_checkpoint(ckpt_dir)
+    named, moments, meta = read_zero_checkpoint(
+        ckpt_dir, allow_missing_moments=allow_missing_moments)
     params = to_repo_params(named, family, cfg)
     mu = to_repo_params(moments["exp_avg"], family, cfg)
     nu = to_repo_params(moments["exp_avg_sq"], family, cfg)

@@ -39,11 +39,23 @@ from deepspeed_tpu.utils.logging import log_dist
 
 
 class BlockedAllocator:
-    """Free-list allocator over the KV block pool
-    (reference ``inference/v2/ragged/blocked_allocator.py``).
+    """Ref-counted free-list allocator over the KV block pool
+    (reference ``inference/v2/ragged/blocked_allocator.py``, grown the
+    SGLang/vLLM prefix-cache direction: blocks carry refcounts so several
+    sequences can share one prefix block, and retired prompt blocks can be
+    *published* into a hash-chained prefix index instead of freed).
 
     Block 0 is reserved as the scratch block that padding tokens write into;
-    it is never handed out.
+    it is never handed out. Published blocks with refcount 0 sit in an LRU
+    and are evicted on demand when ``allocate`` finds the free list dry —
+    the prefix cache is strictly free-memory-funded: ``free_blocks`` counts
+    evictable cached blocks as allocatable, so admission reservations see
+    the same capacity they would without caching and can never deadlock on
+    retained blocks.
+
+    Prefix keys are exact hash-chains: ``key = (parent_key, block_tokens)``
+    per full block (structural sharing keeps them cheap); exact tuples
+    rather than digests so a hash collision can never splice wrong KV.
     """
 
     def __init__(self, num_blocks: int):
@@ -51,25 +63,87 @@ class BlockedAllocator:
             raise ValueError("need at least 2 blocks (one is the scratch block)")
         self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> lowest first
         self.num_blocks = num_blocks
+        self._refs = [0] * num_blocks
+        # prefix cache state (inert until publish() is first called)
+        self._index: dict = {}   # chain key -> block id
+        self._keys: dict[int, Any] = {}  # block id -> its chain key
+        self._lru: dict[int, None] = {}  # refcount-0 published blocks, LRU->MRU
+        self.evictions = 0  # cumulative cached blocks reclaimed under pressure
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: truly free + evictable (refcount-0 cached)."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks currently published in the prefix index (any refcount)."""
+        return len(self._keys)
+
+    @property
+    def retained_blocks(self) -> int:
+        """Refcount-0 cached blocks held back from the free list (the
+        memory the prefix cache is actually occupying right now)."""
+        return len(self._lru)
 
     def allocate(self, n: int) -> list[int]:
-        if n > len(self._free):
+        if n > self.free_blocks:
             raise RuntimeError(
-                f"KV pool exhausted: need {n} blocks, {len(self._free)} free"
+                f"KV pool exhausted: need {n} blocks, {self.free_blocks} free"
             )
-        return [self._free.pop() for _ in range(n)]
+        while len(self._free) < n:
+            self._evict_lru()
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def _evict_lru(self) -> None:
+        b = next(iter(self._lru))  # oldest entry (LRU order)
+        del self._lru[b]
+        del self._index[self._keys.pop(b)]
+        self._free.append(b)
+        self.evictions += 1
 
     def free(self, blocks: list[int]) -> None:
+        """Drop one reference per block; a block reaching refcount 0 returns
+        to the free list, or to the evictable LRU if it is published."""
         for b in blocks:
             if b == 0 or b >= self.num_blocks:
                 raise ValueError(f"bad block id {b}")
-            if b in self._free:
+            if self._refs[b] <= 0:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                if b in self._keys:
+                    self._lru[b] = None  # dict preserves insertion = MRU last
+                else:
+                    self._free.append(b)
+
+    # ------------------------------------------------------- prefix cache
+    def lookup(self, key) -> int | None:
+        """Block id published under ``key``, or None. Read-only (no LRU
+        touch) so the serving router can probe concurrently."""
+        return self._index.get(key)
+
+    def acquire(self, blocks: list[int]) -> None:
+        """Take a reference on cached blocks (a prefix hit splicing them
+        into a sequence's block table). A refcount-0 block leaves the
+        evictable LRU."""
+        for b in blocks:
+            if self._refs[b] == 0:
+                del self._lru[b]
+            self._refs[b] += 1
+
+    def publish(self, block: int, key) -> bool:
+        """Register ``block``'s content under its chain key (called at
+        sequence release, BEFORE ``free``). Returns False when the key is
+        already cached (dedupe: the existing block stays authoritative)."""
+        if key in self._index:
+            return False
+        self._index[key] = block
+        self._keys[block] = key
+        return True
 
 
 @dataclass
@@ -111,6 +185,14 @@ class RaggedConfig:
     # next-token feed riding a device-resident per-slot buffer (bounded
     # speculation; EOS reconciled on readback)
     pipeline_depth: int = 2
+    # block-level prefix caching (SGLang/vLLM-style): retired sequences
+    # publish their full prompt blocks into a hash-chained index; admission
+    # splices the longest cached full-block prefix into a new sequence's
+    # block table (refcounts bumped) and prefills only the tail. Cached
+    # blocks with no referents stay evictable (LRU) so the cache is funded
+    # purely by free memory. Off by default: disabled, scheduling behavior
+    # is bit-identical to an uncached engine.
+    enable_prefix_cache: bool = False
 
     @property
     def max_seq_len(self) -> int:
@@ -131,10 +213,19 @@ class _SeqState:
     blocks: list[int] = field(default_factory=list)
     reserved_remaining: int = 0  # worst-case blocks reserved but not yet held
     done: bool = False
+    # prompt tokens whose KV came from the prefix cache (block-aligned; the
+    # leading cached_prefix // block_size entries of ``blocks`` are SHARED
+    # blocks this sequence must never write — pos starts past them)
+    cached_prefix: int = 0
     # sampling controls (reference generate kwargs; 0-temperature = greedy)
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    # per-request sampling seed: token g of this request draws from
+    # fold_in(fold_in(SAMPLE_ROOT, seed), g) — independent of batch
+    # composition and dispatch history, so a sampled generation is
+    # reproducible on any engine (cache hit == cold, fused == plain)
+    seed: int = 0
     # fused-pipeline bookkeeping: chunks dispatched but not yet reconciled
     # that reference this sequence (release deferred until it drains)
     refs: int = 0
@@ -261,8 +352,20 @@ class RaggedInferenceEngine:
         self._slot_toks = jnp.zeros(self.cfg.max_seqs + 1, jnp.int32)
         # host mirror of which slots have a valid device-side next token
         self._slot_feed = np.zeros(self.cfg.max_seqs + 1, bool)
-        self._dispatch_rng = jax.random.PRNGKey(seed ^ 0x5EED)
-        self._chunk_counter = 0
+        # per-request sampling: token g of a request with effective seed s
+        # draws from fold_in(fold_in(_sample_root, s), g). The root is a
+        # FIXED constant (not engine-seeded) so an explicitly seeded request
+        # reproduces on any engine; auto-assigned seeds mix the engine seed
+        # + put order in instead (legacy whole-engine determinism).
+        self._sample_root = jax.random.PRNGKey(0x5A3D1E)
+        self._engine_seed = int(seed)
+        self._put_counter = 0
+        # prefix-cache accounting (plain ints so the bench can read them
+        # with telemetry off; telemetry mirrors them when enabled)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_reused = 0
+        self._evictions_seen = 0  # high-water for the eviction counter delta
         if self.cfg.fused_chunk == 1 or self.cfg.fused_chunk < 0:
             raise ValueError("fused_chunk must be 0 (off) or >= 2")
         if self.cfg.fused_chunk and self.cfg.pipeline_depth < 1:
@@ -289,15 +392,22 @@ class RaggedInferenceEngine:
     def put(self, uid, prompt_tokens, max_new_tokens: int = 64,
             eos_token_id: int | None = None, temperature: float = 0.0,
             top_k: int = 0, top_p: float = 1.0,
-            deadline_s: float | None = None) -> None:
+            deadline_s: float | None = None,
+            seed: int | None = None) -> None:
         """Enqueue a request (reference ``engine_v2.py put()``). Admission into
         the running batch happens inside ``step()`` as slots/budget free up.
         ``temperature``/``top_k``/``top_p`` select per-request sampling
         (0-temperature = greedy), applied inside the compiled step — sampled
         decode works under run-ahead and the fused pipeline with no host
-        round trip (``inference/sampling.py``). ``deadline_s`` bounds the
-        request's whole lifetime (queue wait included): past it the sequence
-        is released on the next ``step()`` with span status=timeout."""
+        round trip (``inference/sampling.py``). ``seed`` pins the request's
+        sampling stream: token g draws from a key derived only from
+        (seed, g), so the same seeded request yields identical tokens on any
+        engine regardless of batch composition, dispatch mode, or prefix-
+        cache hits; None assigns an engine-seed + arrival-order seed (same
+        engine seed + same put order still reproduces). ``deadline_s``
+        bounds the request's whole lifetime (queue wait included): past it
+        the sequence is released on the next ``step()`` with span
+        status=timeout."""
         prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -318,11 +428,17 @@ class RaggedInferenceEngine:
             )
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError("deadline_s must be positive")
+        if seed is None:
+            eff_seed = (self._engine_seed * 1000003
+                        + self._put_counter) & 0x7FFFFFFF
+        else:
+            eff_seed = int(seed) & 0x7FFFFFFF
+        self._put_counter += 1
         self._queued.append(_SeqState(
             uid=uid, prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id if eos_token_id is not None else self.eos_token_id,
             temperature=float(temperature), top_k=int(top_k),
-            top_p=float(top_p),
+            top_p=float(top_p), seed=eff_seed,
             deadline=(time.perf_counter() + deadline_s) if deadline_s else 0.0,
             t_enqueue=time.perf_counter() if self.telemetry.enabled else 0.0,
         ))
@@ -404,6 +520,54 @@ class RaggedInferenceEngine:
         total = len(seq.prompt) + seq.max_new_tokens
         return -(-total // self.cfg.block_size)
 
+    # ---------------------------------------------------------- prefix cache
+    def _match_prefix(self, prompt: list[int]) -> list[int]:
+        """Longest cached full-block prefix of ``prompt``: walk the hash
+        chain block by block until the first miss. Capped one token short of
+        the full prompt — the first generated token needs the LAST prompt
+        position's logits, which only a real forward produces, and recomputing
+        that token's KV must land in a fresh (unshared) block — so at least
+        the prompt's final block always prefills."""
+        bs = self.cfg.block_size
+        max_blocks = (len(prompt) - 1) // bs
+        alloc = self.allocator
+        blocks: list[int] = []
+        key = None
+        for i in range(max_blocks):
+            key = (key, tuple(prompt[i * bs:(i + 1) * bs]))
+            b = alloc.lookup(key)
+            if b is None:
+                break
+            blocks.append(b)
+        return blocks
+
+    def cached_prefix_len(self, prompt_tokens) -> int:
+        """Tokens of ``prompt_tokens`` the prefix cache could serve right now
+        (block-aligned, always < len(prompt)). Read-only — no refcount or
+        LRU mutation — so the serving router can probe it for admission math
+        from another thread; the answer is advisory (the cache can evict
+        between probe and admission) and admission re-checks under the
+        engine's own reservation accounting."""
+        if not self.cfg.enable_prefix_cache:
+            return 0
+        prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
+        if not prompt:
+            return 0
+        return len(self._match_prefix(prompt)) * self.cfg.block_size
+
+    def _publish_prompt_blocks(self, seq: _SeqState) -> None:
+        """Publish the retired sequence's full prompt blocks into the prefix
+        index (refcount handling stays in ``free``: published blocks fall
+        into the evictable LRU instead of the free list when their last
+        referent drops). Only blocks whose KV was actually scheduled count —
+        a cancelled request mid-prefill publishes just its computed region."""
+        bs = self.cfg.block_size
+        n_full = min(seq.pos, len(seq.prompt)) // bs
+        key = None
+        for i in range(n_full):
+            key = (key, tuple(seq.prompt[i * bs:(i + 1) * bs]))
+            self.allocator.publish(seq.blocks[i], key)
+
     def _ensure_capacity(self, seq: _SeqState, upto: int) -> bool:
         """Grow seq's block table to cover positions [0, upto); False if the
         pool can't satisfy it right now. Admitted sequences draw from their
@@ -433,6 +597,10 @@ class RaggedInferenceEngine:
     def _release(self, seq: _SeqState) -> None:
         self._reserved -= seq.reserved_remaining  # return unused reservation
         seq.reserved_remaining = 0
+        if self.cfg.enable_prefix_cache:
+            # publish BEFORE free: blocks whose last referent drops here land
+            # in the evictable LRU instead of the free list
+            self._publish_prompt_blocks(seq)
         self.allocator.free(seq.blocks)
         seq.blocks = []
         self.block_tables[seq.slot, :] = 0
@@ -505,7 +673,8 @@ class RaggedInferenceEngine:
 
         @partial(jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=(5,))
         def chunk_fn(k, sampled, has_tk, has_tp, params, cache, tokens, slots,
-                     positions, block_tables, rng, temp, topk, topp):
+                     positions, block_tables, root, seeds, gen0, temp, topk,
+                     topp):
             def pick(lg, r):
                 if not sampled:
                     return jnp.argmax(
@@ -519,7 +688,8 @@ class RaggedInferenceEngine:
             def one(carry, i):
                 cache, toks, pos = carry
                 logits, cache = fwd(params, toks, slots, pos, block_tables, cache)
-                nxt = pick(logits, jax.random.fold_in(rng, i))
+                from deepspeed_tpu.inference.sampling import per_request_keys
+                nxt = pick(logits, per_request_keys(root, seeds, gen0 + i))
                 return (cache, nxt, pos + 1), nxt
 
             (cache, _, _), out = jax.lax.scan(
@@ -559,6 +729,8 @@ class RaggedInferenceEngine:
         tokens = np.zeros(bucket, np.int32)
         slots = np.full(bucket, self.cfg.max_seqs, np.int32)
         positions = np.zeros(bucket, np.int32)
+        seeds = np.zeros(bucket, np.int32)
+        gen0 = np.zeros(bucket, np.int32)
         temp = np.zeros(bucket, np.float32)
         topk = np.zeros(bucket, np.int32)
         topp = np.ones(bucket, np.float32)
@@ -567,18 +739,20 @@ class RaggedInferenceEngine:
             tokens[j] = s.token_at(s.pos)
             slots[j] = s.slot
             positions[j] = s.pos
+            # feeding token_at(pos) produces generated[pos+1 - len(prompt)]
+            seeds[j] = s.seed
+            gen0[j] = s.pos - len(s.prompt) + 1
             temp[j], topk[j], topp[j] = s.temperature, s.top_k, s.top_p
             sampled = sampled or s.temperature > 0.0
         if self._chunk_jit is None:
             self._chunk_jit = self._build_decode_chunk()
-        rng = jax.random.fold_in(self._dispatch_rng, self._chunk_counter)
-        self._chunk_counter += 1
         max_pos = max(s.pos + k - 1 for s in seqs)
         out, self.cache = self._chunk_jit(
             k, sampled, bool(topk.any()), bool((topp < 1.0).any()),
             self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(positions),
-            jnp.asarray(self._table_view(max_pos)), rng,
+            jnp.asarray(self._table_view(max_pos)), self._sample_root,
+            jnp.asarray(seeds), jnp.asarray(gen0),
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
         )
         self.dispatch_count += 1
@@ -685,20 +859,21 @@ class RaggedInferenceEngine:
         ct = self.cfg.prefill_tile
         max_seqs = self.cfg.max_seqs
 
-        def pick(logits, rng, temp, tk, tp_):
+        def pick(logits, keys, temp, tk, tp_):
             if not sampled:
                 return jnp.argmax(
                     logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
             from deepspeed_tpu.inference.sampling import sample_tokens
 
-            toks, _ = sample_tokens(logits, rng, temp,
+            toks, _ = sample_tokens(logits, keys, temp,
                                     top_k=tk if has_tk else None,
                                     top_p=tp_ if has_tp else None)
             return toks
 
         def chunk_fn(params, cache, slot_toks, tokens, slots, positions,
                      feed_sel, dec_remaining, pf_last_mask, ts, tp, tv,
-                     block_tables, rng, temp, topk, topp):
+                     block_tables, root, seeds, gidx, temp, topk, topp):
+            from deepspeed_tpu.inference.sampling import per_request_keys
             if nd:
                 fed = jnp.where(feed_sel > 0, slot_toks[slots[:nd]],
                                 tokens[:nd])
@@ -710,7 +885,8 @@ class RaggedInferenceEngine:
             else:
                 logits, cache = fwd(params, tokens, slots, positions,
                                     block_tables, cache)
-            tok0 = pick(logits, rng, temp, topk, topp)
+            tok0 = pick(logits, per_request_keys(root, seeds, gidx),
+                        temp, topk, topp)
             st = slot_toks
             t_total = tokens.shape[0]
             if t_total > nd:
@@ -723,9 +899,18 @@ class RaggedInferenceEngine:
                 def one(carry, i):
                     cache, toks, pos = carry
                     active = i < dec_remaining
+                    # frozen rows (k_s exhausted) must not touch real state:
+                    # slot -> max_seqs routes their KV writes to the all-zero
+                    # scratch row of the block table (block 0, never
+                    # allocated), and the position is clamped to 0 so it can
+                    # never index past any real sequence's table extent —
+                    # without the clamp a frozen row's still-advancing
+                    # ``pos`` overruns its retired table row and only
+                    # gather clamping hides it
                     s = jnp.where(active, slots[:nd], max_seqs)
-                    lg, cache = fwd(params, toks, s, pos, block_tables, cache)
-                    r = jax.random.fold_in(rng, i)
+                    p = jnp.where(active, pos, 0)
+                    lg, cache = fwd(params, toks, s, p, block_tables, cache)
+                    r = per_request_keys(root, seeds[:nd], gidx[:nd] + i)
                     nxt = pick(lg, r, temp[:nd], topk[:nd], topp[:nd])
                     # frozen rows keep their last token (feed stability)
                     nxt = jnp.where(active, nxt, toks)
@@ -765,6 +950,14 @@ class RaggedInferenceEngine:
         nd_full = next(b for b in self._dec_buckets
                        if b >= min(cfg.max_seqs, cfg.max_tokens_per_step))
         combos: set = set()
+        # the dispatcher caps its scan depth at min(k, pow2-roundup of the
+        # deepest remaining budget), so tail batches (everyone nearly done)
+        # hit smaller-k programs too
+        ks = {k}
+        p = 1
+        while p < k:
+            ks.add(p)
+            p *= 2
         if ct:
             cap0 = max(1, (cfg.max_tokens_per_step - 0) // ct)
             capd = max(1, (cfg.max_tokens_per_step - nd_full) // ct)
@@ -779,12 +972,14 @@ class RaggedInferenceEngine:
 
             for nt in nts(cap0):
                 combos.add((1, 0, nt))
-            for nt in nts(capd) | {0}:
-                combos.add((k, nd_full, nt))
+            for kk in ks:
+                for nt in nts(capd) | {0}:
+                    combos.add((kk, nd_full, nt))
         else:
             for b in [0] + self._buckets:
                 combos.add((1, 0, b) if b else None)
-                combos.add((k, nd_full, b))
+                for kk in ks:
+                    combos.add((kk, nd_full, b))
             combos.discard(None)
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.params)
@@ -827,8 +1022,8 @@ class RaggedInferenceEngine:
                     i32(max(nt if ct else 1, 1)),
                     i32(max(nt if ct else 1, 1)),
                     i32(max(nt if ct else 1, 1)),
-                    bt_abs, rng_abs, f32(t_total), i32(t_total),
-                    f32(t_total),
+                    bt_abs, rng_abs, i32(t_total), i32(t_total),
+                    f32(t_total), i32(t_total), f32(t_total),
                 ).compile()
                 n += 1
             except Exception as e:  # pragma: no cover - environment-specific
@@ -896,13 +1091,22 @@ class RaggedInferenceEngine:
         if not decs and not chunks:
             return False
 
-        k = k_max if decs else 1
+        # cap the scan depth at what the decode region can actually use —
+        # rows with k_s < k freeze early, so steps past max(k_s) are pure
+        # scratch-row work. Round UP to a power of two: k is a static jit
+        # arg and arbitrary residuals would each compile a fresh program.
+        if decs:
+            k = min(k_max, 1 << (max(ks for _, ks in decs) - 1).bit_length())
+        else:
+            k = 1
         tokens = np.zeros(max(t_total, 1), np.int32)
         slots = np.full(max(t_total, 1), cfg.max_seqs, np.int32)
         positions = np.zeros(max(t_total, 1), np.int32)
         feed_sel = np.zeros(max(nd, 1), np.int32)
         dec_remaining = np.zeros(max(nd, 1), np.int32)
         pf_last = np.zeros(max(t_total, 1), np.int32)
+        seeds = np.zeros(max(t_total, 1), np.int32)
+        gidx = np.zeros(max(t_total, 1), np.int32)
         temp = np.zeros(max(t_total, 1), np.float32)
         topk = np.zeros(max(t_total, 1), np.int32)
         topp = np.ones(max(t_total, 1), np.float32)
@@ -912,6 +1116,10 @@ class RaggedInferenceEngine:
             slots[j] = seq.slot
             positions[j] = seq.pos
             dec_remaining[j] = k_s
+            # step 0 feeds token_at(pos) -> emits generated index
+            # pos - len(prompt) + 1; scan step i emits that + i
+            seeds[j] = seq.seed
+            gidx[j] = seq.pos - len(seq.prompt) + 1
             temp[j], topk[j], topp[j] = seq.temperature, seq.top_k, seq.top_p
             sampled = sampled or seq.temperature > 0.0
             if self._slot_feed[seq.slot]:
@@ -933,6 +1141,9 @@ class RaggedInferenceEngine:
             tokens[sl] = seq.prompt[seq.pos:seq.pos + take]
             slots[sl] = seq.slot
             positions[sl] = np.arange(seq.pos, seq.pos + take, dtype=np.int32)
+            # only the prompt-completing row's pick is kept (generated
+            # index 0, which gidx already holds); other rows' are discarded
+            seeds[sl] = seq.seed
             temp[sl], topk[sl], topp[sl] = (seq.temperature, seq.top_k,
                                             seq.top_p)
             sampled = sampled or seq.temperature > 0.0
@@ -953,8 +1164,6 @@ class RaggedInferenceEngine:
         self.tokens_scheduled += n0 + active_scan
         self.tokens_padded += (t_total - n0) + (k - 1) * nd - active_scan
 
-        rng = jax.random.fold_in(self._dispatch_rng, self._chunk_counter)
-        self._chunk_counter += 1
         max_pos = max(
             [seq.pos + k_s - 1 for seq, k_s in decs]
             + [seq.pos - 1 for seq, _, _ in chunks], default=0)
@@ -966,7 +1175,8 @@ class RaggedInferenceEngine:
             jnp.asarray(tokens), jnp.asarray(slots), jnp.asarray(positions),
             jnp.asarray(feed_sel), jnp.asarray(dec_remaining),
             jnp.asarray(pf_last), jnp.asarray(ts), jnp.asarray(tpos),
-            jnp.asarray(tval), jnp.asarray(self._table_view(max_pos)), rng,
+            jnp.asarray(tval), jnp.asarray(self._table_view(max_pos)),
+            self._sample_root, jnp.asarray(seeds), jnp.asarray(gidx),
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
         )
         self.dispatch_count += 1
@@ -1069,17 +1279,59 @@ class RaggedInferenceEngine:
     def _admit_queued(self) -> None:
         """Pass 2: admit queued requests while slots remain (their prompt
         chunks are scheduled by pass 3); admission reserves the request's
-        worst-case block count so admitted work always finishes."""
+        worst-case block count so admitted work always finishes.
+
+        With the prefix cache on, admission first splices the longest cached
+        full-block prefix into the sequence's block table (refcounts bumped
+        via ``acquire``) and reserves only the REMAINDER — a hit both skips
+        prefill compute and shrinks the reservation, raising effective
+        capacity. ``seq.pos`` starts past the cached region, so the tail
+        prefill (always >= 1 token, see ``_match_prefix``) produces the
+        first token exactly as a cold prompt's final chunk would."""
+        use_cache = self.cfg.enable_prefix_cache
         while self._queued and self._free_slots:
             seq = self._queued[0]
             worst = self._worst_case_blocks(seq)
+            hit: list[int] = self._match_prefix(seq.prompt) if use_cache else []
+            if hit:
+                # take the references first: free_blocks counts refcount-0
+                # cached blocks as allocatable, so the remainder check below
+                # must see them already claimed
+                self.allocator.acquire(hit)
+                worst -= len(hit)
             if worst > self.allocator.free_blocks - self._reserved:
+                if hit:
+                    # deref back; published blocks re-enter the LRU (at the
+                    # MRU end — they were just asked for)
+                    self.allocator.free(hit)
                 break  # pool pressure: retry admission as blocks free up
             self._queued.pop(0)
             seq.slot = self._free_slots.pop()
             seq.reserved_remaining = worst
             self._reserved += worst
+            if hit:
+                seq.blocks = list(hit)
+                seq.cached_prefix = len(hit) * self.cfg.block_size
+                seq.pos = seq.cached_prefix
+                self.block_tables[seq.slot, :len(hit)] = hit
             self._running[seq.slot] = seq
+            if use_cache:
+                tel = self.telemetry
+                if hit:
+                    self.prefix_hits += 1
+                    self.prefix_tokens_reused += seq.cached_prefix
+                    if tel.enabled:
+                        tel.counter("prefix_cache_hits_total",
+                                    "admissions with a cached prefix").inc()
+                        tel.counter(
+                            "prefix_tokens_reused_total",
+                            "prompt tokens served from cached KV blocks",
+                        ).inc(seq.cached_prefix)
+                else:
+                    self.prefix_misses += 1
+                    if tel.enabled:
+                        tel.counter("prefix_cache_misses_total",
+                                    "admissions with no cached prefix").inc()
             if self.telemetry.enabled:
                 seq.t_admit = time.perf_counter()
 
@@ -1102,19 +1354,19 @@ class RaggedInferenceEngine:
                 if not hasattr(self, "_sample_jits"):
                     self._sample_jits = {}
                 if fkey not in self._sample_jits:
-                    from deepspeed_tpu.inference.sampling import sample_tokens
+                    from deepspeed_tpu.inference.sampling import (
+                        per_request_keys, sample_tokens)
 
                     has_tk, has_tp = fkey
                     self._sample_jits[fkey] = jax.jit(
-                        lambda lg, rng, t, tk, tp: sample_tokens(
-                            lg, rng, t,
+                        lambda lg, root, seeds, gidx, t, tk, tp: sample_tokens(
+                            lg, per_request_keys(root, seeds, gidx), t,
                             top_k=tk if has_tk else None,
                             top_p=tp if has_tp else None)[0])
-                rng = jax.random.fold_in(self._dispatch_rng,
-                                         self._chunk_counter)
-                self._chunk_counter += 1
                 picked = np.asarray(self._sample_jits[fkey](
-                    logits[idx], rng,
+                    logits[idx], self._sample_root,
+                    np.asarray([s.seed for _, s in emit], np.int32),
+                    np.asarray([len(s.generated) for _, s in emit], np.int32),
                     np.asarray([s.temperature for _, s in emit], np.float32),
                     tk, tp))
             else:
@@ -1177,6 +1429,24 @@ class RaggedInferenceEngine:
             self.tokens_padded)
         g("inference_dispatch_count", "device dispatches issued").set(
             self.dispatch_count)
+        if self.cfg.enable_prefix_cache:
+            alloc = self.allocator
+            if alloc.evictions > self._evictions_seen:
+                tel.counter(
+                    "prefix_cache_evictions_total",
+                    "cached KV blocks reclaimed under pool pressure",
+                ).inc(alloc.evictions - self._evictions_seen)
+                self._evictions_seen = alloc.evictions
+            g("prefix_cache_blocks_published",
+              "KV blocks registered in the prefix index").set(
+                  alloc.cached_blocks)
+            g("prefix_cache_blocks_retained",
+              "refcount-0 cached blocks held from the free list").set(
+                  alloc.retained_blocks)
+            decided = self.prefix_hits + self.prefix_misses
+            g("prefix_cache_hit_rate",
+              "fraction of admissions with a cached prefix").set(
+                  self.prefix_hits / decided if decided else 0.0)
 
     def _step_impl(self) -> dict:
         self._sweep_aborts()

@@ -72,10 +72,13 @@ def sample_tokens(logits, rng, temperature, top_k=None, top_p=None,
     """Pick next tokens for a batch of rows.
 
     ``logits`` [T, V] (any float dtype); per-row controls broadcast from
-    scalars. Returns (tokens [T] int32, logprobs [T] fp32) — the logprob is
-    of the chosen token under the FINAL (tempered+filtered) distribution,
-    which is what an RLHF behavior policy must record; greedy rows report the
-    untempered log-softmax.
+    scalars. ``rng`` is either one PRNG key (shared noise source for the
+    batch) or a [T, 2] array of per-row keys — per-row keys make a row's
+    draw a function of that row alone, which is what batch-invariant
+    (prefix-cache-reproducible) sampling needs. Returns (tokens [T] int32,
+    logprobs [T] fp32) — the logprob is of the chosen token under the FINAL
+    (tempered+filtered) distribution, which is what an RLHF behavior policy
+    must record; greedy rows report the untempered log-softmax.
     """
     logits = logits.astype(jnp.float32)
     t = logits.shape[0]
@@ -96,7 +99,13 @@ def sample_tokens(logits, rng, temperature, top_k=None, top_p=None,
         filt = _mask_top_k(filt, top_k)
     if top_p is not None:
         filt = _mask_top_p(filt, top_p)
-    sampled = jax.random.categorical(rng, filt, axis=-1).astype(jnp.int32)
+    rng = jnp.asarray(rng)
+    if rng.ndim == 2:  # [T, 2] per-row keys
+        sampled = jax.vmap(
+            lambda r, lg: jax.random.categorical(r, lg)
+        )(rng, filt).astype(jnp.int32)
+    else:
+        sampled = jax.random.categorical(rng, filt, axis=-1).astype(jnp.int32)
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     toks = jnp.where(greedy, greedy_tok, sampled)
     lp = jnp.where(greedy,
@@ -105,6 +114,19 @@ def sample_tokens(logits, rng, temperature, top_k=None, top_p=None,
                    jnp.take_along_axis(jax.nn.log_softmax(filt, axis=-1),
                                        toks[:, None], axis=-1)[:, 0])
     return toks, lp
+
+
+def per_request_keys(root, seeds, gen_idx):
+    """Derive [T, 2] per-row sampling keys from per-request seeds and
+    generated-token indices: ``fold_in(fold_in(root, seed), g)``.
+
+    The draw for token ``g`` of a request depends only on (root, seed, g) —
+    never on batch composition, dispatch chunking, or engine history — so a
+    sampled generation replays identically whether it runs cold, hits the
+    prefix cache, or lands in a different dispatch mode."""
+    def one(s, g):
+        return jax.random.fold_in(jax.random.fold_in(root, s), g)
+    return jax.vmap(one)(seeds, gen_idx)
 
 
 def update_seen(seen_mask, tokens):

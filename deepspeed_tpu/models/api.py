@@ -137,6 +137,18 @@ class ShardCtx:
             if not 0 < ltd_keep < s:
                 raise ValueError(f"ltd_keep must be in (0, seq={s}), got "
                                  f"{ltd_keep}")
+            # position-free layers (learned embeddings already in x) take
+            # (sub, lp) only. Decide by signature, ONCE, outside the traced
+            # body — catching TypeError around the call would also swallow
+            # genuine TypeErrors raised inside the layer itself
+            import inspect
+            try:
+                params = inspect.signature(layer_fn).parameters
+                takes_positions = "positions" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
+            except (TypeError, ValueError):
+                takes_positions = False  # uninspectable callable (C/builtin)
 
             def body(carry, inp):
                 lp, i = inp
@@ -147,14 +159,11 @@ class ShardCtx:
                 keep = jnp.sort(jnp.concatenate(
                     [jnp.zeros((1,), perm.dtype), perm]))
                 sub = jnp.take(carry, keep, axis=1)
-                pos = jnp.broadcast_to(keep[None, :],
-                                       (carry.shape[0], ltd_keep))
-                try:
+                if takes_positions:
+                    pos = jnp.broadcast_to(keep[None, :],
+                                           (carry.shape[0], ltd_keep))
                     sub = layer_fn(sub, lp, positions=pos)
-                except TypeError as e:
-                    # position-free layers (learned embeddings already in x)
-                    if "positions" not in str(e):
-                        raise
+                else:
                     sub = layer_fn(sub, lp)
                 return carry.at[:, keep].set(sub.astype(carry.dtype)), None
 

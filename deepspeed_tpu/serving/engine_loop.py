@@ -217,6 +217,21 @@ class EngineLoop:
             self._cancel_ids.add(request_id)
         self._wake.set()
 
+    def cached_prefix_tokens(self, prompt_tokens) -> int:
+        """Tokens of ``prompt_tokens`` the engine's prefix cache could serve
+        right now. An ADVISORY cross-thread probe: it reads the engine's
+        prefix index without locking (dict reads are atomic in CPython, and
+        the router only uses the answer to bias placement/admission — a
+        stale answer costs one conservative decision, never correctness).
+        Engines without a prefix cache (or with it disabled) report 0."""
+        probe = getattr(self._engine, "cached_prefix_len", None)
+        if probe is None:
+            return 0
+        try:
+            return int(probe(prompt_tokens))
+        except Exception:  # noqa: BLE001 - advisory: racing a mutation is fine
+            return 0
+
     # --------------------------------------------------------------- stats
     def stats(self) -> ReplicaStats:
         queued, inflight, outstanding, free = self._engine_stats
@@ -251,7 +266,8 @@ class EngineLoop:
                     eng.put(rid, req.prompt, max_new_tokens=req.max_tokens,
                             eos_token_id=req.eos_token_id,
                             temperature=req.temperature, top_k=req.top_k,
-                            top_p=req.top_p, deadline_s=req.deadline_s)
+                            top_p=req.top_p, deadline_s=req.deadline_s,
+                            seed=req.seed)
                     self._open[rid] = _Open(stream)
                 except ValueError as e:
                     stream._fail(str(e))

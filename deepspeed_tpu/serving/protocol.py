@@ -53,6 +53,9 @@ class CompletionRequest:
     eos_token_id: int | None = None
     deadline_s: float | None = None
     priority: int = 0
+    # pins the request's sampling stream: the same (prompt, seed, sampling
+    # params) replays the same tokens on any replica, cold or prefix-cached
+    seed: int | None = None
     request_id: str = field(
         default_factory=lambda: "cmpl-" + uuid.uuid4().hex[:24])
 
@@ -78,6 +81,9 @@ class CompletionRequest:
             self.deadline_s = float(self.deadline_s)
         if self.eos_token_id is not None:
             self.eos_token_id = int(self.eos_token_id)
+        if self.seed is not None:
+            _require(int(self.seed) >= 0, "seed must be >= 0")
+            self.seed = int(self.seed)
         self.priority = int(self.priority)
         self.stream = bool(self.stream)
         _require(isinstance(self.request_id, str) and len(self.request_id) > 0,
@@ -95,6 +101,7 @@ class CompletionRequest:
         known = {
             "prompt", "max_tokens", "temperature", "top_k", "top_p",
             "stream", "eos_token_id", "deadline_s", "priority", "request_id",
+            "seed",
         }
         unknown = set(body) - known
         _require(not unknown, f"unknown fields: {sorted(unknown)}")

@@ -59,8 +59,16 @@ class RouterConfig:
 
 def plan_placement(
     stats: list[ReplicaStats], total_tokens: int, cfg: RouterConfig,
+    cached_tokens: list[int] | None = None,
 ) -> tuple[int | None, str]:
     """Pure admission/placement decision over a stats snapshot.
+
+    ``cached_tokens`` (optional, one entry per replica) is how much of the
+    request's prompt each replica's prefix cache already holds: those
+    full blocks are spliced (not allocated) on admission, so the worst-case
+    block need and the queue-bound token footprint shrink by the cached
+    amount — a replica holding the prefix admits requests a cold one must
+    queue, and ties prefer the replica that reuses the most.
 
     Returns ``(replica_index, verdict)`` where verdict is one of
     ``"admit"`` (free KV blocks now), ``"queue"`` (fits under the queue
@@ -69,21 +77,36 @@ def plan_placement(
     live = [(i, s) for i, s in enumerate(stats) if s.alive and not s.draining]
     if not live:
         return None, "draining"
-    need = {s.name: s.worst_blocks(total_tokens) for _, s in live}
+
+    def cached(i: int) -> int:
+        if not cached_tokens:
+            return 0
+        return max(0, min(cached_tokens[i], total_tokens))
+
+    def need(i: int, s: ReplicaStats) -> int:
+        # cached full blocks are reused, not allocated; the tail still
+        # needs ceil((total - block-aligned cached) / block_size)
+        return s.worst_blocks(total_tokens
+                              - (cached(i) // s.block_size) * s.block_size)
+
+    def load(i: int, s: ReplicaStats) -> int:
+        return s.outstanding_tokens + total_tokens - cached(i)
+
     fits_now = [
         (i, s) for i, s in live
-        if need[s.name] <= s.free_blocks - s.pending_blocks
-        and s.outstanding_tokens + total_tokens <= cfg.max_queue_tokens
+        if need(i, s) <= s.free_blocks - s.pending_blocks
+        and load(i, s) <= cfg.max_queue_tokens
     ]
     if fits_now:
-        i, _ = min(fits_now, key=lambda t: t[1].outstanding_tokens)
+        i, _ = min(fits_now,
+                   key=lambda t: (t[1].outstanding_tokens, -cached(t[0])))
         return i, "admit"
     can_queue = [
-        (i, s) for i, s in live
-        if s.outstanding_tokens + total_tokens <= cfg.max_queue_tokens
+        (i, s) for i, s in live if load(i, s) <= cfg.max_queue_tokens
     ]
     if can_queue:
-        i, _ = min(can_queue, key=lambda t: t[1].outstanding_tokens)
+        i, _ = min(can_queue,
+                   key=lambda t: (t[1].outstanding_tokens, -cached(t[0])))
         return i, "queue"
     return None, "overloaded"
 
@@ -114,7 +137,9 @@ class ReplicaRouter:
             raise ProtocolError(
                 f"prompt+max_tokens = {req.total_tokens} exceeds the "
                 f"serveable maximum ({cap_tokens} tokens)")
-        idx, verdict = plan_placement(stats, req.total_tokens, self.cfg)
+        cached = [r.cached_prefix_tokens(req.prompt) for r in self.replicas]
+        idx, verdict = plan_placement(stats, req.total_tokens, self.cfg,
+                                      cached_tokens=cached)
         tel = get_telemetry()
         if idx is None:
             if verdict == "draining":

@@ -111,7 +111,8 @@ def test_round_trip(tmp_path, stage):
     _write_ds_checkpoint(str(tmp_path), named, stage=stage)
 
     got_named, moments, meta = read_zero_checkpoint(str(tmp_path))
-    assert meta == {"step": 7, "zero_stage": stage, "world_size": 2}
+    assert meta == {"step": 7, "zero_stage": stage, "world_size": 2,
+                    "missing_moments": []}
     for k, v in named.items():
         np.testing.assert_allclose(got_named[k], v, rtol=1e-6)
 
@@ -164,3 +165,47 @@ def test_import_to_engine(tmp_path):
     loss = float(engine.train_batch(
         {"input_ids": rng.integers(0, 64, (16, 8), dtype=np.int32)}))
     assert np.isfinite(loss)
+
+
+def test_missing_moments_raise_unless_allowed(tmp_path):
+    """Stripped optimizer state must not silently zero-fill Adam moments:
+    default raises, allow_missing_moments=True warns + records in meta."""
+    import jax
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(np.asarray, params)
+    _write_ds_checkpoint(str(tmp_path), _torch_named(params), stage=2)
+    for f in os.listdir(str(tmp_path)):
+        if not f.endswith("_optim_states.pt"):
+            continue
+        p = os.path.join(str(tmp_path), f)
+        sd = torch.load(p, map_location="cpu", weights_only=False)
+        st = sd["optimizer_state_dict"]["base_optimizer_state"]["state"][0]
+        del st["exp_avg"], st["exp_avg_sq"]
+        torch.save(sd, p)
+
+    with pytest.raises(ValueError, match="exp_avg"):
+        read_zero_checkpoint(str(tmp_path))
+
+    named, moments, meta = read_zero_checkpoint(
+        str(tmp_path), allow_missing_moments=True)
+    assert meta["missing_moments"] == [(0, 0), (1, 0)]
+    for v in moments["exp_avg"].values():
+        assert not np.any(v)
+
+
+def test_ambiguous_optim_file_order_raises(tmp_path):
+    """>1 optim-state file without a parseable dp rank: glob order would
+    silently scramble the partition concatenation — refuse instead."""
+    import jax
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(np.asarray, params)
+    _write_ds_checkpoint(str(tmp_path), _torch_named(params), stage=2)
+    for i, f in enumerate(sorted(os.listdir(str(tmp_path)))):
+        if f.endswith("_optim_states.pt"):
+            os.rename(os.path.join(str(tmp_path), f),
+                      os.path.join(str(tmp_path),
+                                   f"shard{i}_optim_states.pt"))
+    with pytest.raises(ValueError, match="dp rank"):
+        read_zero_checkpoint(str(tmp_path))

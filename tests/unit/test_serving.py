@@ -65,6 +65,7 @@ class TestProtocol:
             {"prompt": [1], "temperature": -0.1},
             {"prompt": [1], "top_p": 0.0},
             {"prompt": [1], "deadline_s": -1},
+            {"prompt": [1], "seed": -3},
             {"prompt": [1], "frequency_penalty": 1.0},  # unknown field
         ):
             with pytest.raises(ProtocolError):
@@ -75,6 +76,9 @@ class TestProtocol:
             {"prompt": [3, 1, 4], "max_tokens": 5, "stream": True})
         assert req.stream and req.total_tokens == 8
         assert req.request_id.startswith("cmpl-")
+        assert req.seed is None
+        req = CompletionRequest.from_json({"prompt": [3], "seed": 7})
+        assert req.seed == 7
 
     def test_sse_round_trip(self):
         frames = [{"id": "r1", "token": 17, "index": 0},
