@@ -444,6 +444,145 @@ def serve_trial_main():
     }))
 
 
+def decode_steady_main():
+    """Child process: steady-state decode dispatch-overhead benchmark.
+
+    The PR-4 target: once every live sequence is decoding, the engine's
+    per-dispatch host work should be admission-free — device-resident
+    scheduler rows, delta-synced block table, one packed staging buffer,
+    double-buffered readback. This trial runs the SAME pure-decode workload
+    through (a) the device-resident path, (b) the legacy host-staged path
+    (``device_state=False``), and (c) the dense padded engine, and reports
+    tokens/s plus a host-staging vs readback vs H2D breakdown per dispatch.
+    It then re-checks token parity (device vs host-staged) across all four
+    dispatch modes with greedy and seeded sampling — a perf path that
+    changes tokens is a non-result. One JSON line out.
+    """
+    import numpy as np
+    import jax
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.ragged import RaggedConfig, RaggedInferenceEngine
+    from deepspeed_tpu.models import llama
+
+    e = os.environ
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model_cfg = llama.LlamaConfig(
+            vocab_size=32768, hidden_size=2048, intermediate_size=5632,
+            num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=1024)
+        n_req, prompt_len, max_new = 16, 64, 96
+        max_seqs, budget, block, ahead = 16, 256, 32, 32
+        fused, depth, tile = 16, 3, 64
+    else:
+        model_cfg = llama.LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=688,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256)
+        n_req, prompt_len = 4, 16
+        max_new = int(e.get("BENCH_STEADY_MAX_NEW", 24))
+        max_seqs, budget, block, ahead = 4, 64, 16, 8
+        fused, depth, tile = 4, 2, 16
+
+    rng = np.random.default_rng(0)
+    # equal-length prompts: the dense baseline then pads nothing, so the
+    # ragged-vs-dense ratio isolates dispatch overhead, not padding waste
+    prompts = [rng.integers(0, model_cfg.vocab_size, (prompt_len,),
+                            dtype=np.int32) for _ in range(n_req)]
+    mbs = -(-(prompt_len + max_new) // block)
+    build_model = lambda ctx: llama.build(model_cfg, ctx=ctx)  # noqa: E731
+
+    def build(device_state, **over):
+        kw = dict(max_tokens_per_step=budget, max_seqs=max_seqs,
+                  block_size=block, num_blocks=max_seqs * mbs + 1,
+                  max_blocks_per_seq=mbs, decode_run_ahead=ahead,
+                  prefill_tile=tile, fused_chunk=fused, pipeline_depth=depth,
+                  device_state=device_state)
+        kw.update(over)
+        return RaggedInferenceEngine(
+            model=build_model, ragged_config=RaggedConfig(**kw), seed=0)
+
+    def run(engine, tag):
+        for i, p in enumerate(prompts):
+            engine.put((tag, i), p, max_new_tokens=max_new)
+        return engine.generate_all()
+
+    def measure(device_state):
+        engine = build(device_state)
+        run(engine, "warm")  # compiles every bucket this workload hits
+        # reset the dispatch-overhead meters: the warmup pass pays tracing +
+        # compilation on the host, which is not steady-state staging cost
+        engine.host_stage_ns = engine.readback_ns = 0
+        engine.h2d_bytes = engine._h2d_seen = 0
+        d0 = engine.dispatch_count
+        t0 = time.perf_counter()
+        out = run(engine, "run")
+        dt = time.perf_counter() - t0
+        disp = max(engine.dispatch_count - d0, 1)
+        toks = sum(len(v) for v in out.values())
+        return {
+            "tokens_per_s": round(toks / dt, 1),
+            "host_stage_ms_per_step": round(
+                engine.host_stage_ns / disp / 1e6, 4),
+            "readback_ms_per_step": round(
+                engine.readback_ns / disp / 1e6, 4),
+            "h2d_bytes_per_step": round(engine.h2d_bytes / disp, 1),
+            "dispatches": disp,
+            "wall_s": round(dt, 3),
+        }, out
+
+    dev, dev_out = measure(True)
+    host, host_out = measure(False)
+
+    dense = InferenceEngine(model=build_model, seed=0)
+    batch = np.stack(prompts)
+    dense.generate(batch, max_new_tokens=max_new)  # compile
+    t0 = time.perf_counter()
+    dense.generate(batch, max_new_tokens=max_new)
+    dense_tok_s = n_req * max_new / (time.perf_counter() - t0)
+
+    # token parity, all 4 dispatch modes x greedy+seeded, device vs host
+    modes = {
+        "plain": dict(decode_run_ahead=0, prefill_tile=0, fused_chunk=0),
+        "tiled": dict(decode_run_ahead=0, fused_chunk=0),
+        "run_ahead": dict(prefill_tile=0, fused_chunk=0),
+        "fused": {},
+    }
+
+    def parity_run(engine):
+        for i, p in enumerate(prompts[:3]):
+            kw = {} if i == 0 else dict(temperature=0.9, top_k=20,
+                                        top_p=0.9, seed=7 + i)
+            engine.put(i, p, max_new_tokens=6, **kw)
+        return engine.generate_all()
+
+    parity = {name: parity_run(build(True, **over))
+              == parity_run(build(False, **over))
+              for name, over in modes.items()}
+
+    print(json.dumps({
+        "steady_ragged_tokens_per_s": dev["tokens_per_s"],
+        "steady_host_staged_tokens_per_s": host["tokens_per_s"],
+        "steady_dense_tokens_per_s": round(dense_tok_s, 1),
+        "steady_ragged_vs_dense": round(
+            dev["tokens_per_s"] / dense_tok_s, 3),
+        # the headline: how much per-dispatch host staging the
+        # device-resident path removed vs the pre-PR host-staged path
+        "steady_staging_reduction": round(
+            host["host_stage_ms_per_step"]
+            / max(dev["host_stage_ms_per_step"], 1e-9), 2),
+        "steady_device_state": dev,
+        "steady_host_staged": host,
+        "steady_outputs_match": dev_out == host_out,
+        "steady_parity": parity,
+        "steady_reqs": n_req,
+        "steady_max_new": max_new,
+    }))
+
+
+def run_decode_steady_subprocess(timeout: float = 900.0):
+    return _run_flagged_subprocess("BENCH_DECODE_STEADY", timeout)
+
+
 def infinity_trial_main():
     """Child process: ZeRO-Infinity offload rung — train a model whose fp32
     training state EXCEEDS the chip's HBM (params + Adam moments + grads),
@@ -1097,9 +1236,16 @@ def smoke_main():
 def main():
     if "--mode" in sys.argv:
         mode = sys.argv[sys.argv.index("--mode") + 1:][:1]
+        if mode == ["decode-steady"]:
+            result, err = run_decode_steady_subprocess()
+            if result is None:
+                print(f"decode-steady bench failed:\n{err}", file=sys.stderr)
+                return 1
+            print(json.dumps(result))
+            return 0
         if mode != ["serving"]:
             print(f"bench: unknown --mode {mode or '(missing)'}; "
-                  "supported: serving", file=sys.stderr)
+                  "supported: serving, decode-steady", file=sys.stderr)
             return 2
         if "--shared-prefix-tokens" in sys.argv:
             # shared-prompt workload: prompts share an N-token prefix and
@@ -1125,6 +1271,9 @@ def main():
     if os.environ.get("BENCH_SERVE"):
         _enable_jit_cache()
         return serve_trial_main()
+    if os.environ.get("BENCH_DECODE_STEADY"):
+        _enable_jit_cache()
+        return decode_steady_main()
     if os.environ.get("BENCH_LEARN"):
         _enable_jit_cache()
         return learn_trial_main()

@@ -129,6 +129,19 @@ def per_request_keys(root, seeds, gen_idx):
     return jax.vmap(one)(seeds, gen_idx)
 
 
+def keys_for_positions(root, seeds, positions, prompt_lens):
+    """Per-row sampling keys derived from DEVICE-RESIDENT scheduler rows.
+
+    The token produced by feeding position ``p`` of a request is its
+    generated-token index ``p - prompt_len + 1`` (a decode row feeds
+    ``generated[p - prompt_len]`` and yields the next one; the prompt's
+    final row, ``p = prompt_len - 1``, yields index 0). Computing the index
+    on device from the persistent position/prompt-len rows keeps the key
+    derivation batch-invariant — identical to ``per_request_keys`` with a
+    host-computed ``gen_idx`` — without staging any host array."""
+    return per_request_keys(root, seeds, positions - prompt_lens + 1)
+
+
 def update_seen(seen_mask, tokens):
     """Mark freshly emitted tokens in the occurrence mask ([T, V] x [T])."""
     return seen_mask.at[jnp.arange(tokens.shape[0]), tokens].set(True)

@@ -308,8 +308,13 @@ class EngineLoop:
         for s in eng._queued:
             outstanding += len(s.prompt) + s.max_new_tokens
         for s in eng._running.values():
+            # Under async readback (device-resident dispatch, fused pipeline)
+            # s.pos runs ahead of len(s.generated) by the in-flight window;
+            # tokens already scheduled on device are progress, not load the
+            # admission controller should throttle on.
+            progress = max(len(s.generated), s.pos - len(s.prompt))
             outstanding += max(0, len(s.prompt) - s.pos) + \
-                (s.max_new_tokens - len(s.generated))
+                max(0, s.max_new_tokens - progress)
         self._engine_stats = (
             len(eng._queued), len(eng._running), outstanding,
             eng.allocator.free_blocks - eng._reserved)

@@ -44,6 +44,7 @@ def probe_extra_xla_flags(
     timeout: float = 120.0,
     use_cache: bool = True,
     env_overrides: dict[str, str | None] | None = None,
+    keep_transient: bool = False,
 ) -> list[str]:
     """Return the subset of ``candidates`` this environment's XLA flag parsers accept.
 
@@ -57,6 +58,14 @@ def probe_extra_xla_flags(
 
     ``env_overrides`` lets the caller make the probe child's environment match
     the real child it is probing on behalf of (value ``None`` = unset).
+
+    ``keep_transient`` flips the default-deny stance for transient verdicts:
+    candidates whose probe fails *indeterminately* (timeout, import crash) are
+    adopted instead of dropped.  Use it when the candidates were already in
+    the environment — there, dropping on a flaky probe silently changes the
+    user's configuration, so only a definitive ``Unknown flag`` rejection may
+    remove a flag.  Transient verdicts are never cached either way, so the
+    cache stays verdict-pure and shared across both stances.
     """
     base_names = {f.split("=", 1)[0] for f in base_flags.split()}
     candidates = [
@@ -126,9 +135,15 @@ def probe_extra_xla_flags(
 
     verdict = _probe(candidates)
     definitive = verdict != "transient"
+    # A transient batch verdict can hide a definitively-bad flag; under
+    # keep_transient that flag would otherwise ride through and kill the real
+    # child, so bisect on transient batches too, not just rejected ones.
+    bisect = len(candidates) > 1 and (
+        verdict == "rejected" or (verdict == "transient" and keep_transient)
+    )
     if verdict == "ok":
         accepted = list(candidates)
-    elif verdict == "rejected" and len(candidates) > 1:
+    elif bisect:
         accepted = []
         for c in candidates:
             v = _probe([c])
@@ -136,6 +151,10 @@ def probe_extra_xla_flags(
                 accepted.append(c)
             elif v == "transient":
                 definitive = False
+                if keep_transient:
+                    accepted.append(c)
+    elif verdict == "transient" and keep_transient:
+        accepted = list(candidates)
     else:
         accepted = []
 
@@ -146,3 +165,50 @@ def probe_extra_xla_flags(
         except OSError:
             pass
     return accepted
+
+
+# --xla_<platform>_* flags register only when that platform's backend links
+# in, so a child forced onto a different platform F-aborts on them before
+# any probe could help.  Used by sanitize_xla_flags to pre-drop statically.
+_PLATFORM_PREFIXES = {"cpu": "--xla_cpu", "gpu": "--xla_gpu",
+                      "tpu": "--xla_tpu"}
+
+
+def sanitize_xla_flags(
+    flags: str,
+    target_platform: str = "cpu",
+    timeout: float = 120.0,
+    use_cache: bool = True,
+    env_overrides: dict[str, str | None] | None = None,
+) -> str:
+    """Filter an *inherited* ``XLA_FLAGS`` string down to what a child forced
+    onto ``target_platform`` can actually parse.
+
+    The failure this guards against: a parent running under TPU (or a stale
+    probe cache) leaves platform-specific flags in the environment; a
+    subprocess spawned with ``JAX_PLATFORMS=cpu`` then dies in
+    ``parse_flags_from_env.cc`` with ``Unknown flag in XLA_FLAGS: ...``
+    before running a single line of user code.
+
+    Two passes.  Flags carrying another platform's name prefix
+    (``--xla_tpu*`` when forcing CPU, and so on) are dropped statically — the
+    target backend never registers them, and probing each costs a subprocess.
+    The survivors are then probed in the child's environment
+    (``env_overrides`` should match the real child) with
+    ``keep_transient=True``: these flags were already in the environment, so
+    only a definitive ``Unknown flag`` rejection removes one; flaky probes
+    keep it.  Order is preserved.  Returns the sanitized flag string.
+    """
+    toks = [t for t in flags.split() if t]
+    if not toks:
+        return ""
+    wrong = tuple(p for plat, p in _PLATFORM_PREFIXES.items()
+                  if plat != target_platform)
+    survivors = [t for t in toks if not t.startswith(wrong)]
+    if not survivors:
+        return ""
+    kept = set(probe_extra_xla_flags(
+        survivors, timeout=timeout, use_cache=use_cache,
+        env_overrides=env_overrides, keep_transient=True,
+    ))
+    return " ".join(t for t in survivors if t in kept)

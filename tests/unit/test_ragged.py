@@ -115,8 +115,9 @@ class TestRaggedEngine:
         assert got == expect
         # the run-ahead path actually engaged: far fewer host steps than
         # tokens generated would imply is impossible to check directly, but
-        # the chunk program must have compiled
-        assert fused._chunk_jit is not None
+        # the chunk program must have compiled (device-resident variant by
+        # default; legacy _chunk_jit when device_state is off)
+        assert fused._dev_chunk_jits or fused._chunk_jit is not None
 
     def test_run_ahead_respects_eos_and_limits(self):
         """EOS inside a fused chunk truncates the stream exactly as the
@@ -173,7 +174,8 @@ class TestRaggedEngine:
             tiled.put(uid, p, max_new_tokens=max_new)
         got = tiled.generate_all()
         assert got == expect
-        assert tiled._tiled_jits, "tiled step programs never engaged"
+        assert (any(key[2] > 0 for key in tiled._dev_step_jits)
+                or tiled._tiled_jits), "tiled step programs never engaged"
 
     def test_tiled_prefill_rejected_without_model_support(self):
         import dataclasses
@@ -296,3 +298,133 @@ class TestRaggedEngine:
         assert ragged_token_slots < dense_token_slots, (
             f"ragged {ragged_token_slots} >= dense {dense_token_slots}"
         )
+
+
+# the four dispatch modes the device-resident state must stay
+# token-identical in (mirrors test_prefix_cache.MODES)
+DISPATCH_MODES = {
+    "plain": {},
+    "tiled": {"prefill_tile": 8},
+    "run_ahead": {"decode_run_ahead": 4},
+    "fused": {"fused_chunk": 4, "pipeline_depth": 2},
+}
+
+
+def _engine_ds(device_state, **over):
+    import dataclasses
+
+    cfg = dataclasses.replace(RCFG, device_state=device_state, **over)
+    return RaggedInferenceEngine(
+        lambda ctx: llama.build(CFG, ctx=ctx), cfg, dtype=jnp.float32, seed=0)
+
+
+class TestDeviceResidentState:
+    """cfg.device_state keeps slot rows / block table / feed tokens on
+    device and double-buffers readback; it must be token-identical to the
+    legacy host-staged path in every mode, greedy and seeded-sampled."""
+
+    @pytest.mark.parametrize("mode", list(DISPATCH_MODES))
+    def test_token_parity_vs_host_staged(self, mode):
+        kw = DISPATCH_MODES[mode]
+        outs = {}
+        for dev in (False, True):
+            eng = _engine_ds(dev, **kw)
+            for uid, p in _prompts(17).items():
+                eng.put(uid, p, max_new_tokens=8)
+            eng.put("s1", _prompts(19)["b"], max_new_tokens=8,
+                    temperature=0.9, top_k=20, seed=123)
+            eng.put("s2", _prompts(19)["a"], max_new_tokens=6,
+                    temperature=0.7, top_p=0.9, seed=7)
+            outs[dev] = eng.generate_all()
+        assert outs[True] == outs[False]
+        # the sampled streams really sampled (not a greedy fallback)
+        greedy = _engine_ds(True, **kw)
+        greedy.put("s1", _prompts(19)["b"], max_new_tokens=8)
+        assert greedy.generate_all()["s1"] != outs[True]["s1"]
+
+    def test_steady_decode_stages_zero_bytes(self):
+        """The whole point: once every sequence is decoding, the packed
+        staging buffer byte-compares equal step to step and the block table
+        has no dirty rows — further steps upload NOTHING."""
+        # block_size 16: the whole request (11 prompt + 5 new = 16 tokens)
+        # fits one block, so no mid-decode table growth dirties a row
+        eng = _engine_ds(True, block_size=16, num_blocks=13,
+                         max_blocks_per_seq=8)
+        eng.put("a", _prompts(23)["b"], max_new_tokens=5)
+        eng.step()  # prefill dispatch
+        eng.step()  # first decode dispatch (staging buffer cached here)
+        assert all(s.in_decode for s in eng._running.values())
+        h2d0 = eng.h2d_bytes
+        for _ in range(2):
+            eng.step()
+        assert eng.h2d_bytes == h2d0, (
+            "steady-state decode still staging host bytes")
+
+    def test_readback_is_double_buffered(self):
+        """A dispatched step's tokens are reconciled one step later (window
+        of one pending dispatch), and drain() flushes the window."""
+        eng = _engine_ds(True)
+        eng.put("a", _prompts()["a"], max_new_tokens=6)
+        eng.step()  # prefill dispatched, nothing reconciled yet
+        assert len(eng._pending) == 1
+        assert eng._results.get("a") is None
+        eng.drain()
+        assert not eng._pending
+        out = eng.generate_all()
+        assert len(out["a"]) == 6
+
+    @pytest.mark.parametrize("mode", list(DISPATCH_MODES))
+    def test_cancel_mid_flight_with_pending_dispatch(self, mode):
+        """cancel() while a dispatch is in flight: the sequence retires via
+        the deferred-release machinery, its KV blocks and slot recycle, and
+        the remaining request still finishes with correct tokens."""
+        kw = DISPATCH_MODES[mode]
+        want = None
+        for with_cancel in (False, True):
+            eng = _engine_ds(True, **kw)
+            prompts = _prompts(29)
+            eng.put("keep", prompts["b"], max_new_tokens=8)
+            if with_cancel:
+                eng.put("dead", prompts["c"], max_new_tokens=8)
+            eng.step()  # dispatch in flight referencing both
+            if with_cancel:
+                assert eng.cancel("dead")
+            out = eng.generate_all()
+            if with_cancel:
+                assert eng.get_request("dead").status == "cancelled"
+            if want is None:
+                want = out["keep"]
+            else:
+                assert out["keep"] == want
+        assert len(eng._free_slots) == RCFG.max_seqs
+        usable = RCFG.num_blocks - 1
+        assert eng.allocator.free_blocks == usable
+
+    def test_deadline_timeout_mid_flight(self):
+        eng = _engine_ds(True)
+        eng.put("t", _prompts()["c"], max_new_tokens=40, deadline_s=0.05)
+        eng.step()
+        import time as _time
+
+        _time.sleep(0.08)
+        eng.generate_all()
+        seq = eng.get_request("t")
+        assert seq.status == "timeout"
+        assert len(eng._free_slots) == RCFG.max_seqs
+
+    def test_slot_reuse_rewrites_device_rows(self):
+        """A retired slot reused by a new request must behave as a fresh
+        row (seed/params rewritten at admission): an oversubscribed sampled
+        workload matches the legacy host-staged path request for request."""
+        eng = _engine_ds(True)
+        fresh = _engine_ds(False)
+        for wave in (0, 1):
+            for uid, p in _prompts(wave).items():
+                eng.put(f"{wave}-{uid}", p, max_new_tokens=5,
+                        temperature=0.8, seed=100 + wave)
+        got = eng.generate_all()
+        for wave in (0, 1):
+            for uid, p in _prompts(wave).items():
+                fresh.put(f"{wave}-{uid}", p, max_new_tokens=5,
+                          temperature=0.8, seed=100 + wave)
+        assert fresh.generate_all() == got

@@ -145,3 +145,55 @@ class TestFusedPipeline:
             legacy.put(uid, p, max_new_tokens=6)
             fused.put(uid, p, max_new_tokens=6)
         assert fused.generate_all() == legacy.generate_all()
+
+
+class TestFusedDeviceState:
+    """The fused pipeline over device-resident scheduler rows
+    (``device_state=True``, the default) vs the legacy host-staged path."""
+
+    def test_device_vs_host_staged_parity_staggered_eos(self):
+        """The hardest fused case: staggered arrivals (slot rows written
+        mid-pipeline) + mid-stream EOS (device-speculated post-EOS tokens
+        discarded at reconcile) + sampled rows. Device-resident state must
+        reproduce the host-staged streams token for token."""
+        prompts = _prompts(5, seed=21)
+        base = _engine(fused_chunk=4, device_state=False)
+        for uid, p in prompts.items():
+            base.put(uid, p, max_new_tokens=8)
+        eos = int(next(iter(base.generate_all().values()))[0])
+
+        def run(device_state):
+            eng = _engine(fused_chunk=4, device_state=device_state)
+            items = list(prompts.items())
+            fed = 0
+            for step in range(500):
+                if fed < len(items) and step % 2 == 0:
+                    uid, p = items[fed]
+                    kw = (dict(temperature=0.8, top_k=20, seed=uid)
+                          if uid % 2 else {})
+                    eng.put(uid, p, max_new_tokens=8, eos_token_id=eos, **kw)
+                    fed += 1
+                if eng.has_work:
+                    eng.step()
+                if fed == len(items) and not eng.has_work:
+                    break
+            assert not eng.has_work
+            return {uid: list(s.generated) for uid, s in eng._results.items()}
+
+        assert run(True) == run(False)
+
+    def test_warmup_lowers_device_fused_programs(self):
+        """warmup() must precompile the DEVICE variant of the fused program
+        zoo when device_state is on — a serve-time compile stall on the
+        first mixed chunk is exactly what warmup exists to prevent."""
+        eng = _engine(fused_chunk=4, depth=2)
+        assert eng.cfg.device_state
+        n = eng.warmup()
+        assert n > 0
+        assert eng._dev_fused_jits  # device programs, not the legacy cache
+        prompts = _prompts(4, seed=17)
+        legacy = _engine(fused_chunk=4, device_state=False)
+        for uid, p in prompts.items():
+            eng.put(uid, p, max_new_tokens=6)
+            legacy.put(uid, p, max_new_tokens=6)
+        assert eng.generate_all() == legacy.generate_all()
