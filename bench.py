@@ -162,10 +162,11 @@ def trial_main():
             "tile_size": int(e.get("BENCH_TILE", "2048")),
         }
     # every bench run doubles as a telemetry fixture: step spans, HBM
-    # watermarks, and the final registry snapshot land in a JSONL next to
-    # the JSON result line (docs/OBSERVABILITY.md)
+    # watermarks, and the final registry snapshot land in a JSONL under
+    # runs/ (gitignored; docs/OBSERVABILITY.md)
     tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_telemetry.jsonl"))
+        os.path.dirname(os.path.abspath(__file__)), "runs",
+        "BENCH_telemetry.jsonl"))
     config["telemetry"] = {"enabled": True, "jsonl_path": tel_path}
     engine, _, _, _ = deepspeed_tpu.initialize(
         # remat/policy inherit from the config via ShardCtx (single source)
@@ -268,7 +269,7 @@ def serve_trial_main():
     from deepspeed_tpu import telemetry
 
     tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
+        os.path.dirname(os.path.abspath(__file__)), "runs",
         "BENCH_serve_telemetry.jsonl"))
     telemetry.configure(enabled=True, jsonl_path=tel_path)
 
@@ -825,7 +826,7 @@ def serving_bench_main():
     shared_prefix = int(e.get("BENCH_SERVING_SHARED_PREFIX", 0))
 
     tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
+        os.path.dirname(os.path.abspath(__file__)), "runs",
         "BENCH_serving_telemetry.jsonl"))
     telemetry.configure(enabled=True, jsonl_path=tel_path)
 

@@ -253,6 +253,10 @@ class _SeqState:
     # dispatch mode's release machinery retires the sequence on the next step
     deadline: float = 0.0
     status: str = "finished"
+    # request-trace context (telemetry.tracing.TraceContext). Only ever
+    # non-None while the tracer is enabled AND this request was sampled, so
+    # ``seq.trace is not None`` is the complete hot-path guard
+    trace: Any = None
 
     def token_at(self, p: int) -> int:
         if p < len(self.prompt):
@@ -438,6 +442,21 @@ class RaggedInferenceEngine:
         # per-token decode latency / preemptions) + KV-occupancy gauges; every
         # emit is behind the singleton's enabled flag
         self.telemetry = get_telemetry()
+        # request tracer: the object reference is stable for the process
+        # lifetime (only its enabled flag toggles), so dispatch paths guard
+        # on one attribute read and allocate nothing while tracing is off
+        self._tracer = self.telemetry.tracer
+        # compile observability: every dispatch notes whether its jitted
+        # program already existed (warm) or was created now (cold = a jit
+        # cache miss at serve time); warmup() flips _warmed so coverage
+        # distinguishes expected first-compiles from shape-busting traffic
+        self.program_dispatches = 0
+        self.program_cold_dispatches = 0
+        self._warmed = False
+        # specialization keys already dispatched for the paths whose jit
+        # cache is internal to jax (no explicit program dict to probe)
+        self._chunk_keys: set = set()
+        self._step_keys: set = set()
         log_dist(
             f"RaggedInferenceEngine: model={self.spec.name} "
             f"budget={self.cfg.max_tokens_per_step} max_seqs={self.cfg.max_seqs} "
@@ -449,7 +468,7 @@ class RaggedInferenceEngine:
             eos_token_id: int | None = None, temperature: float = 0.0,
             top_k: int = 0, top_p: float = 1.0,
             deadline_s: float | None = None,
-            seed: int | None = None) -> None:
+            seed: int | None = None, trace=None) -> None:
         """Enqueue a request (reference ``engine_v2.py put()``). Admission into
         the running batch happens inside ``step()`` as slots/budget free up.
         ``temperature``/``top_k``/``top_p`` select per-request sampling
@@ -463,7 +482,10 @@ class RaggedInferenceEngine:
         engine seed + same put order still reproduces). ``deadline_s``
         bounds the request's whole lifetime (queue wait included): past it
         the sequence is released on the next ``step()`` with span
-        status=timeout."""
+        status=timeout. ``trace`` threads a serving-side trace context
+        (``telemetry.tracing.TraceContext``) so the request's engine spans
+        parent under the HTTP root; with the tracer enabled and no context
+        given, the engine head-samples a fresh trace per request."""
         prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -490,6 +512,16 @@ class RaggedInferenceEngine:
         else:
             eff_seed = int(seed) & 0x7FFFFFFF
         self._put_counter += 1
+        if self._tracer.enabled:
+            # seq.trace is the request's umbrella "engine/request" span:
+            # a child of the serving root when one was threaded in, or a
+            # fresh head-sampled root for direct engine use. The span id is
+            # allocated now so queue/admission/dispatch/readback children
+            # can parent to it; the span itself is recorded at release.
+            trace_ctx = (self._tracer.begin(trace) if trace is not None
+                         else self._tracer.extract(None))
+        else:
+            trace_ctx = None
         self._queued.append(_SeqState(
             uid=uid, prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id if eos_token_id is not None else self.eos_token_id,
@@ -497,6 +529,7 @@ class RaggedInferenceEngine:
             top_p=float(top_p), seed=eff_seed,
             deadline=(time.perf_counter() + deadline_s) if deadline_s else 0.0,
             t_enqueue=time.perf_counter() if self.telemetry.enabled else 0.0,
+            trace=trace_ctx,
         ))
         if self.telemetry.enabled:
             self.telemetry.counter(
@@ -709,10 +742,23 @@ class RaggedInferenceEngine:
         if ttft is not None:
             tel.histogram("inference_ttft_seconds",
                           "time to first token").observe(ttft)
+            tel.observe_slo("ttft", ttft)
         if decode_latency is not None:
             tel.histogram("inference_decode_latency_seconds",
                           "mean inter-token decode latency").observe(
                               decode_latency)
+            tel.observe_slo("decode_latency", decode_latency)
+        if seq.trace is not None:
+            # close the request's umbrella span: every queue/admission/
+            # dispatch/readback child recorded along the way nests under it
+            t_end = seq.t_last_token or time.perf_counter()
+            t_start = seq.t_enqueue or t_end
+            self._tracer.finish(
+                seq.trace, "engine/request", t_start, t_end,
+                uid=str(seq.uid), status=seq.status,
+                prompt_tokens=len(seq.prompt), new_tokens=n_gen,
+                ttft_s=ttft, preemptions=seq.preemptions or None)
+            seq.trace = None  # released: nothing may record under it now
 
     def _build_step(self) -> Callable:
         fwd = self.spec.ragged_forward_fn
@@ -829,6 +875,32 @@ class RaggedInferenceEngine:
                 buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
                          50.0)).observe(dt * 1e3)
 
+    def _trace_spans(self, t0: float, t1: float, pairs, **attrs) -> None:
+        """Record one child span per traced sequence over the window
+        [t0, t1]. ``pairs`` is ``[(seq, span_name, tokens)]`` — callers
+        build it (and call this) only when ``self._tracer.enabled``, so the
+        untraced hot path allocates nothing."""
+        tr = self._tracer
+        for seq, name, ntok in pairs:
+            tr.record(seq.trace, name, t0, t1, tokens=ntok, **attrs)
+
+    def _note_program(self, kind: str, novel: bool) -> None:
+        """Compile observability: every dispatch notes whether its jitted
+        program already existed (warm) or had to be created (cold — the
+        request's shape fell outside the cached bucket ladder, so XLA is
+        compiling mid-serve). Feeds the ``warmup_coverage`` gauge and the
+        per-family miss counter; ``warmup()`` zeroes the running totals so
+        coverage reflects post-warmup traffic only."""
+        self.program_dispatches += 1
+        if not novel:
+            return
+        self.program_cold_dispatches += 1
+        if self.telemetry.enabled:
+            self.telemetry.counter(
+                "ragged_program_cache_misses_total",
+                "dispatches that created a new jitted program (shape "
+                "outside the cached bucket ladder)").inc(kind=kind)
+
     def _get_dev_step(self, t: int, nd: int, nt: int, w: int, sampled: bool,
                       has_tk: bool, has_tp: bool):
         """Device-resident SplitFuse step (plain or tiled): feed tokens and
@@ -840,6 +912,7 @@ class RaggedInferenceEngine:
         width, sampling-filter flags)."""
         key = (t, nd, nt, w, sampled, has_tk, has_tp)
         fn = self._dev_step_jits.get(key)
+        self._note_program("dev_step", fn is None)
         if fn is not None:
             return fn
         fwd = self.spec.ragged_forward_fn
@@ -901,6 +974,7 @@ class RaggedInferenceEngine:
         run (zero upload)."""
         key = (k, t, w, sampled, has_tk, has_tp)
         fn = self._dev_chunk_jits.get(key)
+        self._note_program("dev_chunk", fn is None)
         if fn is not None:
             return fn
         fwd = self.spec.ragged_forward_fn
@@ -1002,6 +1076,10 @@ class RaggedInferenceEngine:
         self._pending.append({"kind": "chunk", "out": out, "emits": emits,
                               "participants": seqs})
         self._note_dispatch(t0)
+        if self._tracer.enabled:
+            self._trace_spans(t0, time.perf_counter(),
+                              [(s, "engine/decode", k) for s in seqs],
+                              mode="dev_run_ahead")
         return True
 
     def _dispatch_step_device(self) -> bool:
@@ -1015,6 +1093,8 @@ class RaggedInferenceEngine:
         ct = cfg.prefill_tile if self._use_tiles else 0
         budget = cfg.max_tokens_per_step
         t0 = time.perf_counter()
+        trace_on = self._tracer.enabled
+        tpairs = [] if trace_on else None
         size = budget + ct
         tokens = np.zeros(size, np.int32)
         slots = np.full(size, cfg.max_seqs, np.int32)
@@ -1036,6 +1116,8 @@ class RaggedInferenceEngine:
             slots[n_dec] = seq.slot
             flags[n_dec] = 3  # feed token+position from device state | emit
             emit.append((n_dec, seq))
+            if trace_on:
+                tpairs.append((seq, "engine/decode", 1))
             max_pos = max(max_pos, seq.pos)
             seq.pos += 1
             n_dec += 1
@@ -1063,6 +1145,8 @@ class RaggedInferenceEngine:
                 max_pos = max(max_pos, seq.pos + take - 1)
                 seq.pos += take
                 sched += take
+                if trace_on:
+                    tpairs.append((seq, "engine/prefill", take))
                 if seq.pos == len(seq.prompt):
                     flags[start + take - 1] |= 2
                     emit.append((start + take - 1, seq))
@@ -1088,6 +1172,8 @@ class RaggedInferenceEngine:
                 max_pos = max(max_pos, seq.pos + take - 1)
                 seq.pos += take
                 n += take
+                if trace_on:
+                    tpairs.append((seq, "engine/prefill", take))
                 if seq.pos == len(seq.prompt):
                     flags[n - 1] |= 2
                     emit.append((n - 1, seq))
@@ -1121,6 +1207,9 @@ class RaggedInferenceEngine:
                               "emit": emit,
                               "participants": list(participants.values())})
         self._note_dispatch(t0)
+        if trace_on:
+            self._trace_spans(t0, time.perf_counter(), tpairs,
+                              mode="dev_step")
         return True
 
     def _reconcile_pending(self) -> dict:
@@ -1133,12 +1222,20 @@ class RaggedInferenceEngine:
         out: dict = {}
         if rec["kind"] == "step":
             picked = np.asarray(rec["picked"])
-            self.readback_ns += int((time.perf_counter() - t0) * 1e9)
+            t1 = time.perf_counter()
+            self.readback_ns += int((t1 - t0) * 1e9)
+            if self._tracer.enabled:
+                self._trace_spans(t0, t1, [(s, "engine/readback", 1)
+                                           for _, s in rec["emit"]])
             for row, seq in rec["emit"]:
                 self._append_tokens(seq, [int(picked[row])], out)
         else:
             toks = np.asarray(rec["out"])  # [K, bucket]
-            self.readback_ns += int((time.perf_counter() - t0) * 1e9)
+            t1 = time.perf_counter()
+            self.readback_ns += int((t1 - t0) * 1e9)
+            if self._tracer.enabled:
+                self._trace_spans(t0, t1, [(s, "engine/readback", k)
+                                           for s, k in rec["emits"]])
             for j, (seq, k) in enumerate(rec["emits"]):
                 self._append_tokens(seq, toks[:k, j], out)
         for seq in rec["participants"]:
@@ -1217,8 +1314,16 @@ class RaggedInferenceEngine:
         if self._chunk_jit is None:
             self._chunk_jit = self._build_decode_chunk()
         max_pos = max(s.pos + k - 1 for s in seqs)
+        has_tk = bool(topk.any())
+        has_tp = bool((topp < 1.0).any())
+        # jit specializes per (statics, shapes); track the key ourselves so
+        # cold dispatches are observable (no explicit program dict here)
+        ckey = (k, sampled, has_tk, has_tp, bucket,
+                self._table_width(max_pos))
+        self._note_program("chunk", ckey not in self._chunk_keys)
+        self._chunk_keys.add(ckey)
         out, self.cache = self._chunk_jit(
-            k, sampled, bool(topk.any()), bool((topp < 1.0).any()),
+            k, sampled, has_tk, has_tp,
             self.params, self.cache,
             self._h2d(tokens), self._h2d(slots), self._h2d(positions),
             self._h2d(self._table_view(max_pos)), self._sample_root,
@@ -1226,9 +1331,15 @@ class RaggedInferenceEngine:
             self._h2d(temp), self._h2d(topk), self._h2d(topp),
         )
         self._note_dispatch(t0)
-        t0 = time.perf_counter()
+        t1 = time.perf_counter()
         out = np.asarray(out)  # [K, bucket]
-        self.readback_ns += int((time.perf_counter() - t0) * 1e9)
+        t2 = time.perf_counter()
+        self.readback_ns += int((t2 - t1) * 1e9)
+        if self._tracer.enabled:
+            self._trace_spans(t0, t1, [(s, "engine/decode", k) for s in seqs],
+                              mode="run_ahead")
+            self._trace_spans(t1, t2,
+                              [(s, "engine/readback", k) for s in seqs])
         self.tokens_scheduled += k * t
         self.tokens_padded += k * (bucket - t)
         emit: dict = {}
@@ -1332,6 +1443,7 @@ class RaggedInferenceEngine:
         """
         key = (k, nd, nt, sampled, has_tk, has_tp)
         fn = self._fused_jits.get(key)
+        self._note_program("fused", fn is None)
         if fn is not None:
             return fn
         fwd = self.spec.ragged_forward_fn
@@ -1528,6 +1640,11 @@ class RaggedInferenceEngine:
 
                 logger.warning("warmup: combo (k=%s nd=%s nt=%s) failed to "
                                "precompile: %s", kk, nd, nt, e)
+        # warmup's own program-cache fills are not serve-time misses: reset
+        # the dispatch baseline so warmup_coverage reflects live traffic only
+        self._warmed = True
+        self.program_dispatches = 0
+        self.program_cold_dispatches = 0
         return n
 
     def _dispatch_fused(self) -> bool:
@@ -1681,6 +1798,13 @@ class RaggedInferenceEngine:
             self._h2d(temp), self._h2d(topk), self._h2d(topp),
         )
         self._note_dispatch(t0)
+        if self._tracer.enabled:
+            t1 = time.perf_counter()
+            self._trace_spans(
+                t0, t1,
+                [(s, "engine/decode", ks) for s, ks in decs]
+                + [(s, "engine/prefill", take) for s, _, take in chunks],
+                mode="fused")
 
         participants: dict[int, _SeqState] = {}
         for seq, k_s in decs:
@@ -1713,6 +1837,7 @@ class RaggedInferenceEngine:
         (| tile metadata)] — constant bytes across steady decode chunks."""
         key = (t, k, nd, nt, w, sampled, has_tk, has_tp)
         fn = self._dev_fused_jits.get(key)
+        self._note_program("dev_fused", fn is None)
         if fn is not None:
             return fn
         fwd = self.spec.ragged_forward_fn
@@ -1893,6 +2018,13 @@ class RaggedInferenceEngine:
             "participants": list(participants.values()),
         })
         self._note_dispatch(t0)
+        if self._tracer.enabled:
+            t1 = time.perf_counter()
+            self._trace_spans(
+                t0, t1,
+                [(s, "engine/decode", ks) for s, ks in decs]
+                + [(s, "engine/prefill", take) for s, _, take in chunks],
+                mode="dev_fused")
         return True
 
     def _append_tokens(self, seq: _SeqState, toks, out: dict) -> None:
@@ -1913,7 +2045,13 @@ class RaggedInferenceEngine:
         t0 = time.perf_counter()
         dec_toks = np.asarray(rec["dec_toks"])
         tok0 = np.asarray(rec["tok0"])
-        self.readback_ns += int((time.perf_counter() - t0) * 1e9)
+        t1 = time.perf_counter()
+        self.readback_ns += int((t1 - t0) * 1e9)
+        if self._tracer.enabled:
+            self._trace_spans(
+                t0, t1,
+                [(s, "engine/readback", ks) for s, ks in rec["decs"]]
+                + [(s, "engine/readback", 1) for _, s in rec["pf_done"]])
         out: dict = {}
         for row, seq in rec["pf_done"]:
             self._append_tokens(seq, [int(tok0[row])], out)
@@ -1990,6 +2128,7 @@ class RaggedInferenceEngine:
         use_cache = self.cfg.enable_prefix_cache
         while self._queued and self._free_slots:
             seq = self._queued[0]
+            t_adm0 = time.perf_counter() if seq.trace is not None else 0.0
             worst = self._worst_case_blocks(seq)
             hit: list[int] = self._match_prefix(seq.prompt) if use_cache else []
             if hit:
@@ -2036,6 +2175,18 @@ class RaggedInferenceEngine:
                                     "admissions with no cached prefix").inc()
             if self.telemetry.enabled:
                 seq.t_admit = time.perf_counter()
+                if seq.trace is not None:
+                    tr = self._tracer
+                    # queue wait (enqueue -> admission pickup) and the
+                    # admission work itself (prefix match + splice +
+                    # reservation), both children of the request span
+                    if seq.t_enqueue:
+                        tr.record(seq.trace, "request/queue",
+                                  seq.t_enqueue, t_adm0)
+                    tr.record(seq.trace, "request/admission",
+                              t_adm0, seq.t_admit, slot=seq.slot,
+                              blocks_reserved=seq.reserved_remaining,
+                              cached_prefix_tokens=seq.cached_prefix or None)
 
     def _emit_tokens(self, logits, emit) -> dict:
         """Shared step epilogue: pick at the emit indices (greedy, or the
@@ -2056,6 +2207,9 @@ class RaggedInferenceEngine:
                 fkey = (bool(tk.any()), bool((tp < 1.0).any()))
                 if not hasattr(self, "_sample_jits"):
                     self._sample_jits = {}
+                skey = ("sample", fkey, len(emit))
+                self._note_program("sample", skey not in self._step_keys)
+                self._step_keys.add(skey)
                 if fkey not in self._sample_jits:
                     from deepspeed_tpu.inference.sampling import (
                         per_request_keys, sample_tokens)
@@ -2075,7 +2229,11 @@ class RaggedInferenceEngine:
             else:
                 picked = np.asarray(
                     jnp.argmax(logits[idx].astype(jnp.float32), axis=-1))
-            self.readback_ns += int((time.perf_counter() - t0) * 1e9)
+            t1 = time.perf_counter()
+            self.readback_ns += int((t1 - t0) * 1e9)
+            if self._tracer.enabled:
+                self._trace_spans(t0, t1, [(s, "engine/readback", 1)
+                                           for _, s in emit])
             now = time.perf_counter() if self.telemetry.enabled else 0.0
             for (_, seq), tok in zip(emit, picked):
                 seq.generated.append(int(tok))
@@ -2139,6 +2297,17 @@ class RaggedInferenceEngine:
                 "bytes staged host-to-device by ragged dispatches").inc(
                     self.h2d_bytes - self._h2d_seen)
             self._h2d_seen = self.h2d_bytes
+        if self.program_dispatches:
+            g("ragged_warmup_coverage",
+              "fraction of dispatches served by an already-built jitted "
+              "program (1.0 = no serve-time compiles since warmup)").set(
+                  1.0 - self.program_cold_dispatches
+                  / self.program_dispatches)
+        tel.note_program_cache_size(
+            len(self._tiled_jits) + len(self._fused_jits)
+            + len(self._dev_step_jits) + len(self._dev_chunk_jits)
+            + len(self._dev_fused_jits) + len(self._chunk_keys)
+            + len(self._step_keys))
         if self.cfg.enable_prefix_cache:
             alloc = self.allocator
             if alloc.evictions > self._evictions_seen:
@@ -2185,6 +2354,10 @@ class RaggedInferenceEngine:
         positions = np.zeros(budget, np.int32)
         emit: list[tuple[int, _SeqState]] = []
         n = self._schedule_decodes(budget, tokens, slots, positions, emit)
+        trace_on = self._tracer.enabled
+        # emit holds exactly the decode rows at this point
+        tpairs = ([(s, "engine/decode", 1) for _, s in emit]
+                  if trace_on else None)
 
         # 3) prefill chunks for running prompts within the remaining budget
         for seq in list(self._running.values()):
@@ -2201,6 +2374,8 @@ class RaggedInferenceEngine:
             positions[sl] = np.arange(seq.pos, seq.pos + take, dtype=np.int32)
             seq.pos += take
             n += take
+            if trace_on:
+                tpairs.append((seq, "engine/prefill", take))
             if seq.pos == len(seq.prompt):
                 emit.append((n - 1, seq))  # last prompt token -> first new token
 
@@ -2209,20 +2384,28 @@ class RaggedInferenceEngine:
         self.tokens_scheduled += n
         self.tokens_padded += bucket - n
 
+        max_pos = int(positions[:n].max(initial=0))
+        skey = ("step", bucket, self._table_width(max_pos))
+        self._note_program("step", skey not in self._step_keys)
+        self._step_keys.add(skey)
         logits, self.cache = self._step_jit(
             self.params, self.cache,
             self._h2d(tokens[:bucket]), self._h2d(slots[:bucket]),
             self._h2d(positions[:bucket]),
-            self._h2d(self._table_view(int(positions[:n].max(initial=0)))),
+            self._h2d(self._table_view(max_pos)),
         )
         self._note_dispatch(t0)
+        if trace_on:
+            self._trace_spans(t0, time.perf_counter(), tpairs, mode="step")
         return self._emit_tokens(logits, emit)
 
     def _get_tiled_step(self, nd: int, nt: int):
         """Jitted step with a static (decode-count, tile-count) split; one
         program per bucket pair."""
         key = (nd, nt)
-        if key not in self._tiled_jits:
+        fn = self._tiled_jits.get(key)
+        self._note_program("tiled", fn is None)
+        if fn is None:
             fwd = self.spec.ragged_forward_fn
             ct = self.cfg.prefill_tile
 
@@ -2230,8 +2413,9 @@ class RaggedInferenceEngine:
                 return fwd(params, tokens, slots, positions, bt, cache,
                            prefill_tiles=(nd, ts, tp, tv, ct))
 
-            self._tiled_jits[key] = jax.jit(step_fn, donate_argnums=(1,))
-        return self._tiled_jits[key]
+            fn = jax.jit(step_fn, donate_argnums=(1,))
+            self._tiled_jits[key] = fn
+        return fn
 
     def _step_tiled(self) -> dict:
         """One SplitFuse step with tile-aligned prefill layout: tokens
@@ -2247,6 +2431,10 @@ class RaggedInferenceEngine:
         emit: list[tuple[int, _SeqState]] = []
         n_dec = self._schedule_decodes(min(budget, self.cfg.max_seqs),
                                        tokens, slots, positions, emit)
+        trace_on = self._tracer.enabled
+        # emit holds exactly the decode rows at this point
+        tpairs = ([(s, "engine/decode", 1) for _, s in emit]
+                  if trace_on else None)
         self._admit_queued()
         nd = 0 if n_dec == 0 else next(b for b in self._dec_buckets
                                        if b >= n_dec)
@@ -2263,6 +2451,8 @@ class RaggedInferenceEngine:
                 seq.pos, seq.pos + take, dtype=np.int32)
             seq.pos += take
             sched += take
+            if trace_on:
+                tpairs.append((seq, "engine/prefill", take))
             if seq.pos == len(seq.prompt):
                 emit.append((start + take - 1, seq))
         self._deadlock_guard(n_dec + sched)
@@ -2292,6 +2482,8 @@ class RaggedInferenceEngine:
             self._h2d(self._table_view(max_pos)),
         )
         self._note_dispatch(t0)
+        if trace_on:
+            self._trace_spans(t0, time.perf_counter(), tpairs, mode="tiled")
         return self._emit_tokens(logits, emit)
 
     # ------------------------------------------------------------------ convenience

@@ -23,6 +23,7 @@ import heapq
 import itertools
 import queue
 import threading
+import time
 from dataclasses import dataclass
 
 from deepspeed_tpu.serving.protocol import (
@@ -31,6 +32,7 @@ from deepspeed_tpu.serving.protocol import (
     FINISH_STOP,
     CompletionRequest,
 )
+from deepspeed_tpu.telemetry import get_telemetry
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -262,12 +264,19 @@ class EngineLoop:
                 cancels.discard(rid)
                 stream._finish(FINISH_CANCELLED)
             else:
+                if req.trace_ctx is not None and req.t_submit:
+                    # frontend submit → loop-thread pickup: the cross-thread
+                    # inbox wait, recorded retroactively from the stamp
+                    get_telemetry().tracer.record(
+                        req.trace_ctx, "loop/inbox_wait", req.t_submit,
+                        time.perf_counter(), replica=self.name,
+                        priority=req.priority)
                 try:
                     eng.put(rid, req.prompt, max_new_tokens=req.max_tokens,
                             eos_token_id=req.eos_token_id,
                             temperature=req.temperature, top_k=req.top_k,
                             top_p=req.top_p, deadline_s=req.deadline_s,
-                            seed=req.seed)
+                            seed=req.seed, trace=req.trace_ctx)
                     self._open[rid] = _Open(stream)
                 except ValueError as e:
                     stream._fail(str(e))

@@ -9,13 +9,27 @@ Endpoints:
   the ``[DONE]`` terminator. Backpressure surfaces as 429 + ``Retry-After``
   (admission control) and 503 (draining); client disconnect mid-stream
   cancels the request so its KV blocks free on the next engine step.
-- ``GET /healthz`` — ``{"status": ready|overloaded|draining}``; 200 when
-  servable, 503 while draining (load-balancer semantics: stop sending).
+- ``GET /healthz`` — ``{"status": ready|degraded|overloaded|draining}``;
+  200 when servable, 503 while draining (load-balancer semantics: stop
+  sending). With an SLO monitor configured the body embeds per-objective
+  burn-rate stats, and a sustained burn flips a ready replica to
+  ``degraded`` (still 200 — it can serve, but tail latency is out of
+  budget; see docs/SERVING.md).
 - ``GET /metrics`` — Prometheus text exposition straight from the PR-1
-  telemetry registry (serving gauges refreshed at scrape time). Serving a
-  scrape endpoint here does not flip telemetry on: with telemetry disabled
-  the page renders whatever the registry holds (typically nothing) and the
-  serving hot path still emits zero metrics.
+  telemetry registry (serving + SLO gauges refreshed at scrape time).
+  Serving a scrape endpoint here does not flip telemetry on: with
+  telemetry disabled the page renders whatever the registry holds
+  (typically nothing) and the serving hot path still emits zero metrics.
+- ``GET /debug/trace`` — the request-trace span ring as Chrome
+  trace-event JSON (load in Perfetto); ``?trace_id=<32hex>`` filters to
+  one trace.
+
+Tracing: ``POST /v1/completions`` honors an incoming W3C ``traceparent``
+header (or head-samples a fresh trace when the tracer is enabled); the
+trace id is echoed in a ``traceparent`` response header, the response
+body, and every SSE token frame, and the context threads through router →
+engine loop → ragged engine so the exported timeline decomposes the
+request into queue/admission/dispatch/readback spans.
 
 ``ThreadingHTTPServer`` gives a thread per connection, which is what SSE
 needs: a streaming response parks its thread on the request's TokenStream
@@ -26,7 +40,9 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from deepspeed_tpu.serving.engine_loop import StreamError
 from deepspeed_tpu.serving.protocol import (
@@ -39,6 +55,7 @@ from deepspeed_tpu.serving.protocol import (
 from deepspeed_tpu.serving.router import Draining, Overloaded, ReplicaRouter
 from deepspeed_tpu.telemetry import get_telemetry
 from deepspeed_tpu.telemetry.exporters import PrometheusExporter
+from deepspeed_tpu.telemetry.tracing import format_traceparent
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -88,6 +105,10 @@ def _make_handler(frontend: ServingFrontend):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
+        # the request's sampled TraceContext (POST path), echoed on replies
+        _trace_ctx = None
+        _last_code = 0
+
         def log_message(self, fmt, *args):  # noqa: A003 - http.server API
             pass  # request logging goes through telemetry, not stderr
 
@@ -95,43 +116,79 @@ def _make_handler(frontend: ServingFrontend):
         def _send_json(self, code: int, payload: dict,
                        headers: dict | None = None) -> None:
             body = json.dumps(payload).encode("utf-8")
+            self._last_code = code
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._trace_ctx is not None:
+                self.send_header("traceparent",
+                                 format_traceparent(self._trace_ctx))
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def _send_error_json(self, code: int, message: str,
-                             headers: dict | None = None) -> None:
-            self._send_json(code, {"error": {"message": message,
-                                             "code": code}}, headers)
+                             headers: dict | None = None, **detail) -> None:
+            err = {"message": message, "code": code}
+            err.update(detail)
+            self._send_json(code, {"error": err}, headers)
 
         # ----------------------------------------------------------- GET
         def do_GET(self):  # noqa: N802 - http.server API
-            if self.path == "/healthz":
+            # keep-alive reuses the handler across requests: clear any
+            # trace context left by an earlier POST on this connection
+            self._trace_ctx = None
+            # route on the path alone — /metrics?foo=1 is still /metrics
+            # (matches the standalone PrometheusExporter's behavior)
+            path, _, query = self.path.partition("?")
+            if path == "/healthz":
                 state = router.state()
-                self._send_json(503 if state == "draining" else 200,
-                                {"status": state})
-            elif self.path == "/metrics":
+                payload = {"status": state}
+                slo = get_telemetry().slo
+                if slo is not None:
+                    payload["slo"] = slo.health()
+                    if state == "ready" and slo.breaching():
+                        # still 200: the replica can serve, but tail
+                        # latency is burning error budget — operators and
+                        # balancers can deprioritize without ejecting it
+                        payload["status"] = "degraded"
+                self._send_json(503 if state == "draining" else 200, payload)
+            elif path == "/metrics":
                 router.refresh_metrics()
-                body = get_telemetry().registry.render_prometheus()
+                tel = get_telemetry()
+                if tel.slo is not None:
+                    tel.slo.refresh_gauges()
+                body = tel.registry.render_prometheus()
                 body = body.encode("utf-8")
+                self._last_code = 200
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  PrometheusExporter.CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif path == "/debug/trace":
+                trace_id = (parse_qs(query).get("trace_id") or [None])[0]
+                self._send_json(
+                    200, get_telemetry().export_chrome_trace(trace_id))
             else:
-                self._send_error_json(404, f"no route for {self.path}")
+                self._send_error_json(404, f"no route for {path}")
 
         # ---------------------------------------------------------- POST
         def do_POST(self):  # noqa: N802 - http.server API
-            if self.path != "/v1/completions":
-                self._send_error_json(404, f"no route for {self.path}")
+            path = self.path.partition("?")[0]
+            if path != "/v1/completions":
+                self._send_error_json(404, f"no route for {path}")
                 return
+            tracer = get_telemetry().tracer
+            # root server span: pre-allocated so everything downstream
+            # (router, engine loop, ragged engine) parents under it;
+            # recorded retroactively once the response is on the wire
+            ctx = tracer.extract(self.headers.get("traceparent"))
+            self._trace_ctx = ctx
+            self._last_code = 0
+            t_req = time.perf_counter()
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -140,6 +197,8 @@ def _make_handler(frontend: ServingFrontend):
                 return
             try:
                 req = CompletionRequest.from_json(body)
+                req.trace_ctx = ctx
+                req.t_submit = t_req
                 stream = router.submit(req)
             except ProtocolError as e:
                 self._send_error_json(400, str(e))
@@ -152,6 +211,13 @@ def _make_handler(frontend: ServingFrontend):
             except Draining as e:
                 self._send_error_json(503, str(e))
                 return
+            finally:
+                if ctx is not None and self._last_code:
+                    # submit was rejected: close the root span here (the
+                    # success path closes it after the response is sent)
+                    tracer.finish(ctx, "http/request", t_req,
+                                  time.perf_counter(),
+                                  status=self._last_code)
             try:
                 if req.stream:
                     self._stream_response(req, stream)
@@ -159,37 +225,65 @@ def _make_handler(frontend: ServingFrontend):
                     self._full_response(req, stream)
             finally:
                 router.release(req.request_id)
+                if ctx is not None:
+                    tracer.finish(ctx, "http/request", t_req,
+                                  time.perf_counter(),
+                                  status=self._last_code,
+                                  request_id=req.request_id,
+                                  stream=req.stream)
 
         def _full_response(self, req, stream) -> None:
             try:
                 tokens, reason = stream.collect(
                     timeout=frontend.request_timeout_s)
-            except (StreamError, TimeoutError) as e:
-                if isinstance(e, TimeoutError):
-                    router.cancel(req.request_id)
+            except StreamError as e:
                 self._send_error_json(400, str(e))
+                return
+            except TimeoutError as e:
+                # the engine never finished inside the frontend's budget:
+                # that is a gateway timeout, not a client error. Abort the
+                # request (frees its KV blocks on the next engine step) and
+                # tell the client when a retry is reasonable.
+                router.cancel(req.request_id)
+                self._send_error_json(
+                    504,
+                    f"request did not complete within "
+                    f"{frontend.request_timeout_s:g}s: {e}",
+                    headers={"Retry-After": "1"},
+                    retry_after_s=1.0,
+                    timeout_s=frontend.request_timeout_s)
                 return
             resp = CompletionResponse(
                 request_id=req.request_id, tokens=tokens,
-                finish_reason=reason, prompt_tokens=len(req.prompt))
+                finish_reason=reason, prompt_tokens=len(req.prompt),
+                trace_id=(req.trace_ctx.trace_id
+                          if req.trace_ctx is not None else None))
             self._send_json(200, resp.to_json())
 
         def _stream_response(self, req, stream) -> None:
+            self._last_code = 200
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
+            if req.trace_ctx is not None:
+                self.send_header("traceparent",
+                                 format_traceparent(req.trace_ctx))
             # no Content-Length for a live stream: HTTP/1.1 needs an
             # explicit close to delimit the body
             self.send_header("Connection", "close")
             self.end_headers()
+            trace_id = (req.trace_ctx.trace_id
+                        if req.trace_ctx is not None else None)
             tokens: list[int] = []
             try:
                 for kind, value in stream.events(
                         timeout=frontend.request_timeout_s):
                     if kind == "token":
-                        self.wfile.write(encode_sse({
-                            "id": req.request_id, "token": value,
-                            "index": len(tokens)}))
+                        frame = {"id": req.request_id, "token": value,
+                                 "index": len(tokens)}
+                        if trace_id:
+                            frame["trace_id"] = trace_id
+                        self.wfile.write(encode_sse(frame))
                         self.wfile.flush()
                         tokens.append(value)
                     elif kind == "error":
@@ -201,7 +295,8 @@ def _make_handler(frontend: ServingFrontend):
                         resp = CompletionResponse(
                             request_id=req.request_id, tokens=tokens,
                             finish_reason=value,
-                            prompt_tokens=len(req.prompt))
+                            prompt_tokens=len(req.prompt),
+                            trace_id=trace_id)
                         self.wfile.write(encode_sse(resp.to_json()))
                         self.wfile.write(sse_done())
                 self.wfile.flush()

@@ -58,6 +58,11 @@ class CompletionRequest:
     seed: int | None = None
     request_id: str = field(
         default_factory=lambda: "cmpl-" + uuid.uuid4().hex[:24])
+    # not wire fields: the frontend attaches the sampled TraceContext here
+    # (None = untraced) and stamps submit time so the engine loop can record
+    # the inbox-wait span retroactively
+    trace_ctx: object = field(default=None, repr=False, compare=False)
+    t_submit: float = field(default=0.0, repr=False, compare=False)
 
     def __post_init__(self):
         _require(isinstance(self.prompt, (list, tuple)) and len(self.prompt) > 0,
@@ -125,9 +130,11 @@ class CompletionResponse:
     finish_reason: str
     prompt_tokens: int
     created: float = field(default_factory=time.time)
+    # trace id echoed to the client when the request was sampled
+    trace_id: str | None = None
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "id": self.request_id,
             "object": "completion",
             "created": self.created,
@@ -142,6 +149,9 @@ class CompletionResponse:
                 "total_tokens": self.prompt_tokens + len(self.tokens),
             },
         }
+        if self.trace_id:
+            out["trace_id"] = self.trace_id
+        return out
 
 
 # ----------------------------------------------------------------- SSE

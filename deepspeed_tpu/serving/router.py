@@ -23,6 +23,7 @@ math is unit-testable without sockets or threads.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 from deepspeed_tpu.serving.engine_loop import (
@@ -129,6 +130,22 @@ class ReplicaRouter:
         Draining / Overloaded / ProtocolError (request can never fit)."""
         if self._draining:
             raise Draining("server is draining")
+        if req.trace_ctx is not None:
+            t0 = time.perf_counter()
+            try:
+                idx, verdict, stream = self._submit_placed(req)
+            except Exception as e:
+                get_telemetry().tracer.record(
+                    req.trace_ctx, "router/submit", t0, time.perf_counter(),
+                    verdict=type(e).__name__.lower())
+                raise
+            get_telemetry().tracer.record(
+                req.trace_ctx, "router/submit", t0, time.perf_counter(),
+                verdict=verdict, replica=idx)
+            return stream
+        return self._submit_placed(req)[2]
+
+    def _submit_placed(self, req: CompletionRequest):
         stats = [r.stats() for r in self.replicas]
         cap_tokens = max(s.max_request_tokens for s in stats)
         cap_blocks = max(s.max_request_blocks for s in stats)
@@ -160,7 +177,7 @@ class ReplicaRouter:
             tel.counter("serving_requests_admitted_total").inc()
             if verdict == "queue":
                 tel.counter("serving_requests_queued_total").inc()
-        return stream
+        return idx, verdict, stream
 
     def cancel(self, request_id: str) -> None:
         replica = self._placements.pop(request_id, None)

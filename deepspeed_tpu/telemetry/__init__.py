@@ -28,6 +28,17 @@ from deepspeed_tpu.telemetry.registry import (  # noqa: F401
     Histogram,
     MetricsRegistry,
 )
+from deepspeed_tpu.telemetry.slo import (  # noqa: F401
+    SloMonitor,
+    SloObjective,
+    default_objectives,
+)
+from deepspeed_tpu.telemetry.tracing import (  # noqa: F401
+    TraceContext,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
 
 
 def get_telemetry() -> Telemetry:
@@ -45,3 +56,9 @@ def snapshot() -> dict:
 
 def dump(path: str) -> dict:
     return TELEMETRY.dump(path)
+
+
+def dump_trace(path: str | None = None, trace_id: str | None = None) -> dict:
+    """Export the request-trace span ring as Chrome trace-event JSON
+    (Perfetto-loadable); writes ``path`` when given."""
+    return TELEMETRY.dump_trace(path, trace_id)

@@ -1,0 +1,148 @@
+"""Compile-cache observability: surface silent XLA recompiles as metrics.
+
+The biggest TPU tail-latency hazard in the ragged serving path is a
+request whose shape falls outside the warmed pow2 bucket ladders: jax
+silently traces + backend-compiles a new program mid-decode and the whole
+batch stalls for seconds. None of that is visible in PR 1's metrics.
+
+Primary mechanism: ``jax.monitoring`` listeners. Every backend compile
+fires ``/jax/core/compile/backend_compile_duration`` (an in-process jit
+cache miss by definition — jax only reaches the backend compiler when no
+cached executable exists), and tracing/lowering phases fire sibling
+``/jax/core/compile/*_duration`` events; the persistent compilation cache
+fires ``/jax/compilation_cache/cache_{hits,misses}``. Listeners are
+process-global in jax, so install is idempotent and uninstall removes
+*only our* callbacks (never ``clear_event_listeners()``, which would nuke
+other tooling's listeners).
+
+Fallback mechanism: on jax builds without usable monitoring hooks the
+watch degrades to cache-size deltas — callers report an observed program
+-cache size (the ragged engine reports its jitted-program zoo size each
+telemetry sample) and any positive delta increments the miss counter with
+``source="cache_size_delta"``.
+
+Metrics:
+
+- ``jit_cache_misses_total{source=}``      backend compiles (jit misses)
+- ``jit_compile_seconds{phase=}``          histogram of compile durations
+- ``persistent_cache_hits_total`` / ``persistent_cache_misses_total``
+"""
+
+from __future__ import annotations
+
+import threading
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+COMPILE_EVENT_PREFIX = "/jax/core/compile/"
+PERSISTENT_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+PERSISTENT_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+# compile times span 10ms CPU traces to multi-minute TPU fusions
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0, 300.0, 600.0)
+
+
+class CompileWatch:
+    """Registers jax.monitoring listeners feeding the metrics registry."""
+
+    def __init__(self, registry):
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._installed = False
+        self.fallback = False
+        self._last_cache_size: int | None = None
+        # bound methods kept so uninstall can remove exactly these
+        self._on_duration = self._duration_listener
+        self._on_event = self._event_listener
+
+    # ------------------------------------------------------------ listeners
+    def _duration_listener(self, event: str, duration: float,
+                           **kwargs) -> None:
+        if not event.startswith(COMPILE_EVENT_PREFIX):
+            return
+        phase = event[len(COMPILE_EVENT_PREFIX):] or "unknown"
+        if phase.endswith("_duration"):
+            phase = phase[: -len("_duration")]
+        reg = self._registry
+        reg.histogram("jit_compile_seconds",
+                      "XLA trace/lower/compile phase durations",
+                      buckets=COMPILE_BUCKETS).observe(duration, phase=phase)
+        if event == BACKEND_COMPILE_EVENT:
+            reg.counter(
+                "jit_cache_misses_total",
+                "backend compiles observed (each is an in-process jit "
+                "cache miss)").inc(source="monitoring")
+
+    def _event_listener(self, event: str, **kwargs) -> None:
+        if event == PERSISTENT_HIT_EVENT:
+            self._registry.counter(
+                "persistent_cache_hits_total",
+                "persistent XLA compilation-cache hits").inc()
+        elif event == PERSISTENT_MISS_EVENT:
+            self._registry.counter(
+                "persistent_cache_misses_total",
+                "persistent XLA compilation-cache misses").inc()
+
+    # --------------------------------------------------------- install/undo
+    def install(self) -> "CompileWatch":
+        with self._lock:
+            if self._installed:
+                return self
+            # pre-create the series so /metrics exposes the counter at zero
+            # (an operator alerting on it must see it before the first miss)
+            self._registry.counter(
+                "jit_cache_misses_total",
+                "backend compiles observed (each is an in-process jit "
+                "cache miss)").inc(0.0, source="monitoring")
+            try:
+                from jax import monitoring
+                monitoring.register_event_duration_secs_listener(
+                    self._on_duration)
+                monitoring.register_event_listener(self._on_event)
+            except Exception:
+                self.fallback = True
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        with self._lock:
+            if not self._installed:
+                return
+            self._installed = False
+            if self.fallback:
+                return
+            try:
+                from jax._src import monitoring as m
+                m._unregister_event_duration_listener_by_callback(
+                    self._on_duration)
+                m._unregister_event_listener_by_callback(self._on_event)
+            except Exception:
+                # best effort across jax versions: drop from the private
+                # lists directly rather than clear_event_listeners(),
+                # which would remove listeners we don't own
+                try:
+                    from jax._src import monitoring as m
+                    for lst in (m._event_duration_secs_listeners,
+                                m._event_listeners):
+                        for cb in (self._on_duration, self._on_event):
+                            while cb in lst:
+                                lst.remove(cb)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------- fallback
+    def note_cache_size(self, n_programs: int) -> None:
+        """Cache-size-delta fallback: callers report how many jitted
+        programs they currently hold; positive deltas count as misses.
+        No-op unless listener registration failed."""
+        if not self.fallback:
+            return
+        with self._lock:
+            last = self._last_cache_size
+            self._last_cache_size = int(n_programs)
+        if last is not None and n_programs > last:
+            self._registry.counter(
+                "jit_cache_misses_total",
+                "backend compiles observed (each is an in-process jit "
+                "cache miss)").inc(n_programs - last,
+                                   source="cache_size_delta")

@@ -24,6 +24,7 @@ from deepspeed_tpu.telemetry.registry import (
     Histogram,
     MetricsRegistry,
 )
+from deepspeed_tpu.telemetry.tracing import Tracer
 
 
 def _as_cfg_dict(cfg) -> dict:
@@ -43,6 +44,11 @@ class Telemetry:
     def __init__(self):
         self.enabled = False
         self.registry = MetricsRegistry()
+        # the tracer object is permanent (engines cache a reference at
+        # construction); only its ``enabled`` flag toggles with configure()
+        self.tracer = Tracer(self.registry)
+        self._slo = None
+        self._compile_watch = None
         self._sinks: list = []
         self._prometheus = None
         self._sampler = None
@@ -86,10 +92,47 @@ class Telemetry:
                     host=str(prom.get("host", "127.0.0.1")),
                     port=int(prom.get("port", 9464)),
                 )
+            tracing = opts.get("tracing") or {}
+            if tracing is True:
+                tracing = {"enabled": True}
+            if tracing.get("enabled"):
+                self.tracer.configure(
+                    enabled=True,
+                    sample_rate=float(tracing.get("sample_rate", 1.0)),
+                    ring_capacity=int(tracing.get("ring_capacity", 4096)),
+                )
+            slo = opts.get("slo") or {}
+            if slo is True:
+                slo = {"enabled": True}
+            if slo.get("enabled"):
+                from deepspeed_tpu.telemetry.slo import (
+                    SloMonitor,
+                    default_objectives,
+                )
+
+                self._slo = SloMonitor(
+                    default_objectives(
+                        ttft_threshold_s=float(
+                            slo.get("ttft_threshold_s", 0.5)),
+                        decode_threshold_s=float(
+                            slo.get("decode_threshold_s", 0.05)),
+                        target=float(slo.get("target", 0.99)),
+                        window_s=float(slo.get("window_s", 300.0)),
+                    ),
+                    self.registry,
+                    burn_threshold=float(slo.get("burn_threshold", 1.0)),
+                )
+                self._slo.refresh_gauges()
+            if opts.get("compile_metrics", True):
+                from deepspeed_tpu.telemetry.compile_watch import CompileWatch
+
+                self._compile_watch = CompileWatch(self.registry).install()
         self.event("telemetry/configured",
                    sinks=[type(s).__name__ for s in self._sinks],
                    prometheus_port=(self._prometheus.port
-                                    if self._prometheus else None))
+                                    if self._prometheus else None),
+                   tracing=self.tracer.enabled,
+                   slo=self._slo is not None)
         return self
 
     @property
@@ -165,6 +208,45 @@ class Telemetry:
             self._sampler = HbmWatermarkSampler(self)
         return self._sampler.sample(step)
 
+    # ------------------------------------------------------------- tracing
+    def export_chrome_trace(self, trace_id: str | None = None) -> dict:
+        """Chrome trace-event JSON of the span ring (Perfetto-loadable)."""
+        return self.tracer.export_chrome(trace_id)
+
+    def dump_trace(self, path: str | None = None,
+                   trace_id: str | None = None) -> dict:
+        """Export the span ring as Chrome trace JSON; writes ``path`` when
+        given, returns the trace dict either way."""
+        if path is None:
+            return self.tracer.export_chrome(trace_id)
+        return self.tracer.dump(path, trace_id)
+
+    # ------------------------------------------------------------- slo
+    @property
+    def slo(self):
+        """The configured :class:`SloMonitor`, or None."""
+        return self._slo
+
+    def observe_slo(self, objective: str, value_s: float) -> None:
+        """Record a request latency against an SLO objective (no-op when
+        no monitor is configured)."""
+        slo = self._slo
+        if slo is not None:
+            slo.record(objective, value_s)
+
+    # ------------------------------------------------------------- compile
+    @property
+    def compile_watch(self):
+        """The installed :class:`CompileWatch`, or None."""
+        return self._compile_watch
+
+    def note_program_cache_size(self, n_programs: int) -> None:
+        """Feed the compile watch's cache-size-delta fallback (no-op when
+        jax.monitoring listeners are active)."""
+        cw = self._compile_watch
+        if cw is not None:
+            cw.note_cache_size(n_programs)
+
     # ------------------------------------------------------------- snapshot
     def snapshot(self) -> dict:
         """The full registry as plain data (JSON-serializable)."""
@@ -222,6 +304,14 @@ class Telemetry:
             self._prometheus = None
         self._sampler = None
         self._since_flush = 0
+        self.tracer.reset()
+        self._slo = None
+        if self._compile_watch is not None:
+            try:
+                self._compile_watch.uninstall()
+            except Exception:
+                pass
+            self._compile_watch = None
 
 
 TELEMETRY = Telemetry()

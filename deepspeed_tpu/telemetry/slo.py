@@ -1,0 +1,156 @@
+"""SLO burn-rate monitoring over rolling request-latency windows.
+
+An objective is "fraction of requests whose latency is under a threshold
+must be at least ``target``" — e.g. 99% of requests see TTFT < 200 ms over
+the last 5 minutes. The *error budget* is ``1 - target``; the *burn rate*
+is the observed bad fraction divided by that budget. Burn rate 1.0 means
+the budget is being consumed exactly as fast as it accrues; sustained
+burn above ``burn_threshold`` flips the objective to "breaching", which
+the serving frontend reflects in ``/healthz`` (status "degraded" — the
+replica still serves, but the balancer/operator is told tail latency is
+out of budget before users file tickets).
+
+Objectives ship with defaults for the two latencies the ragged engine
+already measures per request (``_emit_request_span``): TTFT and mean
+per-token decode latency. Samples live in per-objective deques pruned to
+the window on every record/read, so memory is bounded by arrival rate x
+window and an idle replica decays back to healthy as bad samples age out.
+
+Gauges per objective (labelled ``objective=<name>``):
+
+- ``slo_burn_rate``       bad_fraction / error_budget over the window
+- ``slo_good_fraction``   fraction of in-window requests under threshold
+- ``slo_window_requests`` sample count backing the estimate
+- ``slo_breaching``       1 if burn rate > burn_threshold (min samples met)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+
+class SloObjective:
+    """One rolling-window latency objective."""
+
+    __slots__ = ("name", "threshold_s", "target", "window_s")
+
+    def __init__(self, name: str, threshold_s: float, target: float = 0.99,
+                 window_s: float = 300.0):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"target must be in (0, 1), got {target}")
+        if threshold_s < 0.0:
+            raise ValueError(f"threshold_s must be >= 0, got {threshold_s}")
+        self.name = name
+        self.threshold_s = float(threshold_s)
+        self.target = float(target)
+        self.window_s = float(window_s)
+
+
+def default_objectives(ttft_threshold_s: float = 0.5,
+                       decode_threshold_s: float = 0.05,
+                       target: float = 0.99,
+                       window_s: float = 300.0) -> list[SloObjective]:
+    """The two objectives the ragged engine reports natively: time to
+    first token, and mean per-token decode latency."""
+    return [
+        SloObjective("ttft", ttft_threshold_s, target, window_s),
+        SloObjective("decode_latency", decode_threshold_s, target, window_s),
+    ]
+
+
+class SloMonitor:
+    """Records (timestamp, good?) samples per objective and publishes
+    burn-rate gauges into the metrics registry at record and scrape time."""
+
+    # below this many in-window samples a breach verdict is noise, not signal
+    MIN_SAMPLES = 5
+
+    def __init__(self, objectives, registry, burn_threshold: float = 1.0):
+        self._objectives = {o.name: o for o in objectives}
+        self._samples = {o.name: deque() for o in objectives}
+        self._registry = registry
+        self.burn_threshold = float(burn_threshold)
+        self._lock = threading.Lock()
+
+    @property
+    def objectives(self):
+        return dict(self._objectives)
+
+    # ------------------------------------------------------------- recording
+    def record(self, name: str, value_s: float, now: float | None = None):
+        """Record one request latency against objective ``name`` (unknown
+        names are ignored so callers need no registration handshake)."""
+        obj = self._objectives.get(name)
+        if obj is None:
+            return
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            window = self._samples[name]
+            window.append((t, value_s <= obj.threshold_s))
+            self._prune_locked(name, t)
+        self._publish(name, t)
+
+    def _prune_locked(self, name: str, now: float) -> None:
+        window = self._samples[name]
+        horizon = now - self._objectives[name].window_s
+        while window and window[0][0] < horizon:
+            window.popleft()
+
+    # --------------------------------------------------------------- queries
+    def stats(self, name: str, now: float | None = None) -> dict:
+        """``{count, good_fraction, burn_rate, breaching}`` for one
+        objective over its current window."""
+        obj = self._objectives[name]
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune_locked(name, t)
+            window = list(self._samples[name])
+        count = len(window)
+        good = sum(1 for _, ok in window if ok)
+        good_fraction = good / count if count else 1.0
+        budget = 1.0 - obj.target
+        burn = (1.0 - good_fraction) / budget if budget > 0 else 0.0
+        breaching = count >= self.MIN_SAMPLES and burn > self.burn_threshold
+        return {
+            "count": count,
+            "good_fraction": good_fraction,
+            "burn_rate": burn,
+            "breaching": breaching,
+            "threshold_s": obj.threshold_s,
+            "target": obj.target,
+            "window_s": obj.window_s,
+        }
+
+    def breaching(self) -> bool:
+        return any(self.stats(n)["breaching"] for n in self._objectives)
+
+    def health(self) -> dict:
+        """Per-objective summary embedded in the ``/healthz`` body."""
+        return {n: self.stats(n) for n in self._objectives}
+
+    # --------------------------------------------------------------- gauges
+    def _publish(self, name: str, now: float | None = None) -> None:
+        # the clock must follow the caller's (record passes its timestamp
+        # through; a wall-clock prune here would evict replayed samples)
+        s = self.stats(name, now)
+        reg = self._registry
+        reg.gauge("slo_burn_rate",
+                  "error-budget burn rate over the rolling window"
+                  ).set(s["burn_rate"], objective=name)
+        reg.gauge("slo_good_fraction",
+                  "fraction of in-window requests meeting the objective"
+                  ).set(s["good_fraction"], objective=name)
+        reg.gauge("slo_window_requests",
+                  "requests backing the rolling SLO estimate"
+                  ).set(s["count"], objective=name)
+        reg.gauge("slo_breaching",
+                  "1 when burn rate exceeds the breach threshold"
+                  ).set(1.0 if s["breaching"] else 0.0, objective=name)
+
+    def refresh_gauges(self) -> None:
+        """Re-publish all gauges (call at scrape time so idle windows decay
+        visibly without waiting for the next request)."""
+        for name in self._objectives:
+            self._publish(name)
