@@ -84,6 +84,39 @@ def candidate_ladder(hbm_bytes: float):
     return ladder
 
 
+def _child_error(reason, proc=None, flag=None):
+    """Structured child-process failure record: every trial/bench failure
+    carries the child's rc + tail stderr instead of an opaque string (the
+    BENCH_r05 'rc=1, device relay dead' incident was undiagnosable from
+    the old format). Serializable — top-level failures emit it under an
+    ``"error"`` key in the JSON output."""
+    err = {"reason": reason, "rc": None, "stderr": ""}
+    if flag:
+        err["flag"] = flag
+    if proc is not None:
+        err["rc"] = proc.returncode
+        err["stderr"] = (proc.stderr or proc.stdout or "")[-2000:]
+    return err
+
+
+def _err_text(err):
+    """Human-readable rendering of a _child_error dict (or legacy string)."""
+    if isinstance(err, dict):
+        head = f"reason={err.get('reason')} rc={err.get('rc')}"
+        if err.get("flag"):
+            head += f" flag={err['flag']}"
+        tail = err.get("stderr") or ""
+        return head + ("\n" + tail if tail else "")
+    return str(err)
+
+
+def _fail_json(err):
+    """Emit the structured error as the bench's JSON line (stdout) so
+    automation parses a real ``error`` field instead of grepping stderr."""
+    print(json.dumps(
+        {"error": err if isinstance(err, dict) else {"reason": str(err)}}))
+
+
 def run_trial_subprocess(cfg_tuple, steps: int, timeout: float = 900.0,
                          zero_stage: int | None = None):
     env = dict(os.environ)
@@ -103,16 +136,16 @@ def run_trial_subprocess(cfg_tuple, steps: int, timeout: float = 900.0,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired:
-        return None, "timeout"
+        return None, _child_error(f"trial timed out after {timeout:g}s")
     if proc.returncode != 0:
-        return None, (proc.stderr or proc.stdout)[-2000:]
+        return None, _child_error("trial child exited nonzero", proc)
     for line in reversed(proc.stdout.strip().splitlines()):
         if line.startswith("{"):
             try:
                 return json.loads(line), None
             except json.JSONDecodeError:
                 continue
-    return None, "no JSON in trial output:\n" + proc.stdout[-2000:]
+    return None, _child_error("no JSON in trial output", proc)
 
 
 def trial_main():
@@ -755,16 +788,18 @@ def _run_flagged_subprocess(env_flag: str, timeout: float = 900.0):
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired:
-        return None, "timeout"
+        return None, _child_error(f"timed out after {timeout:g}s",
+                                  flag=env_flag)
     if proc.returncode != 0:
-        return None, (proc.stderr or proc.stdout)[-2000:]
+        return None, _child_error("child exited nonzero", proc, flag=env_flag)
     for line in reversed(proc.stdout.strip().splitlines()):
         if line.startswith("{"):
             try:
                 return json.loads(line), None
             except json.JSONDecodeError:
                 continue
-    return None, f"no JSON in {env_flag} output:\n" + proc.stdout[-2000:]
+    return None, _child_error(f"no JSON in {env_flag} output", proc,
+                              flag=env_flag)
 
 
 def run_learn_subprocess(timeout: float = 900.0):
@@ -964,33 +999,231 @@ def run_serving_subprocess(timeout: float = 900.0):
     return _run_flagged_subprocess("BENCH_SERVING", timeout)
 
 
+def chaos_bench_main():
+    try:
+        return _chaos_bench_impl()
+    except Exception as ex:  # noqa: BLE001 - chaos child must emit JSON
+        import traceback
+        traceback.print_exc()
+        print(json.dumps({"metric": "serving_chaos", "chaos_ok": False,
+                          "error": {"reason": f"{type(ex).__name__}: {ex}"}}))
+        return 1
+
+
+def _chaos_bench_impl():
+    """Child process: chaos smoke over the full serving path.
+
+    Arms a FIXED, seeded fault schedule (deepspeed_tpu/serving/faults.py) —
+    transient dispatch raise, readback hang, a dispatch burst long enough
+    to trip automatic degradation, and a block-allocation fault — then
+    drives concurrent HTTP requests with pinned per-request seeds and
+    checks the fault-tolerance contract end to end: zero hung requests,
+    zero leaked KV blocks after drain, completed requests token-identical
+    to a fault-free reference run, and at least one automatic
+    device_state→host-staged fallback visible in /healthz and telemetry.
+    One JSON line out; ``chaos_ok`` + a structured ``error`` field carry
+    the verdict (see docs/FAULT_TOLERANCE.md).
+    """
+    import http.client
+    import threading
+
+    import numpy as np
+    import jax
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.ragged import (
+        RaggedConfig,
+        RaggedInferenceEngine,
+    )
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.serving import RouterConfig, build_server, faults
+
+    e = os.environ
+    telemetry.configure(enabled=True)
+
+    model_cfg = llama.LlamaConfig(
+        vocab_size=97, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=128)
+
+    def make_engine():
+        rcfg = RaggedConfig(
+            max_tokens_per_step=16, max_seqs=3, block_size=4, num_blocks=49,
+            max_blocks_per_seq=16, decode_run_ahead=4, prefill_tile=8,
+            fused_chunk=4, pipeline_depth=2, device_state=True,
+            dispatch_retries=2, retry_backoff_s=0.01, degrade_after=2)
+        return RaggedInferenceEngine(
+            model=lambda ctx: llama.build(model_cfg, ctx=ctx),
+            ragged_config=rcfg, seed=0)
+
+    n_req = int(e.get("BENCH_CHAOS_REQUESTS", 8))
+    max_new = 8
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 97, (int(n),), dtype=np.int32).tolist()
+               for n in rng.integers(4, 20, n_req)]
+
+    # fault-free reference FIRST (injector still disarmed): per-request
+    # seeds pin the sampled tokens, so the chaos run must reproduce these
+    # exactly for every request the faults didn't kill
+    ref_eng = make_engine()
+    for i, p in enumerate(prompts):
+        ref_eng.put(f"ref-{i}", p, max_new_tokens=max_new, temperature=0.8,
+                    seed=1000 + i)
+    ref_out = ref_eng.generate_all()
+    reference = {i: ref_out[f"ref-{i}"] for i in range(n_req)}
+    del ref_eng
+
+    engine = make_engine()
+    frontend, router, loops = build_server(
+        [engine], router_cfg=RouterConfig())
+    inj = faults.get_fault_injector()
+    inj.configure([
+        # one transient dispatch blip: the watchdog retries it away
+        {"point": faults.POINT_DISPATCH, "kind": "raise", "after": 1},
+        # a wedged readback surfacing as TimeoutError: also transient
+        {"point": faults.POINT_READBACK, "kind": "hang", "after": 6,
+         "delay_s": 0.01},
+        # a dispatch failure burst: with degrade_after=2 this forces the
+        # automatic device_state→host-staged fallback (and possibly the
+        # plain-step rung after it)
+        {"point": faults.POINT_DISPATCH, "kind": "raise", "after": 10,
+         "times": 4},
+        # one block-allocation fault mid-admission
+        {"point": faults.POINT_ALLOC, "kind": "raise", "after": 2},
+    ], seed=int(e.get("BENCH_CHAOS_SEED", 0)))
+
+    results: dict = {}
+    lock = threading.Lock()
+
+    def one_request(i):
+        conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                          timeout=120)
+        body = json.dumps({"prompt": prompts[i], "max_tokens": max_new,
+                           "temperature": 0.8, "seed": 1000 + i})
+        try:
+            conn.request("POST", "/v1/completions", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = json.loads(resp.read() or b"{}")
+            with lock:
+                results[i] = (resp.status, data)
+        except Exception as ex:  # noqa: BLE001 - a dropped conn is a result
+            with lock:
+                results[i] = (None, {"error": {"reason": str(ex)}})
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=one_request, args=(i,), daemon=True)
+               for i in range(n_req)]
+    for th in threads:
+        th.start()
+        time.sleep(0.05)  # stagger arrivals so faults land mid-flight
+    for th in threads:
+        th.join(timeout=180)
+    hung = sum(1 for th in threads if th.is_alive())
+
+    # health + metrics BEFORE drain: degradation must be visible live
+    conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                      timeout=30)
+    conn.request("GET", "/healthz")
+    healthz = json.loads(conn.getresponse().read())
+    conn.request("GET", "/metrics")
+    metrics_text = conn.getresponse().read().decode("utf-8")
+    conn.close()
+
+    fired = inj.counts()
+    inj.reset()  # disarm before drain so shutdown can't re-fire
+    drained = frontend.drain(timeout=60)
+    leaked = (engine.cfg.num_blocks - 1) - engine.allocator.free_blocks
+
+    completed = [i for i, (st, _) in results.items() if st == 200]
+    mismatches = [
+        i for i in completed
+        if results[i][1]["choices"][0]["tokens"] != reference[i]
+    ]
+    metric_degraded = any(
+        line.split()[-1] not in ("0", "0.0")
+        for line in metrics_text.splitlines()
+        if line.startswith(("degraded_mode", "replica_degraded_mode")))
+    checks = {
+        "no_hung_requests": hung == 0,
+        "no_leaked_blocks": leaked == 0,
+        "drained_clean": bool(drained),
+        "all_responses_terminal": len(results) == n_req,
+        "parity_with_fault_free_run": not mismatches and bool(completed),
+        "auto_degraded": engine.degraded_mode >= 1,
+        "healthz_degraded": healthz.get("status") == "degraded",
+        "metrics_degraded": metric_degraded,
+    }
+    ok = all(checks.values())
+    telemetry.TELEMETRY.close()
+    print(json.dumps({
+        "metric": "serving_chaos",
+        "chaos_ok": ok,
+        "error": None if ok else {
+            "reason": "chaos assertions failed",
+            "failed": sorted(k for k, v in checks.items() if not v)},
+        "chaos_checks": checks,
+        "chaos_requests": n_req,
+        "chaos_completed": len(completed),
+        "chaos_failed": len(results) - len(completed),
+        "chaos_hung": hung,
+        "chaos_leaked_blocks": leaked,
+        "chaos_parity_mismatches": len(mismatches),
+        "chaos_degraded_mode": engine.degraded_mode,
+        "chaos_degraded_reason": engine.degraded_reason,
+        "chaos_step_retries": engine.step_retries,
+        "chaos_step_failures": engine.step_failures,
+        "chaos_loop_crashes": loops[0].crash_count,
+        "chaos_loop_respawns": loops[0].respawn_count,
+        "chaos_faults_fired": fired,
+        "chaos_healthz": healthz.get("status"),
+        "backend": jax.default_backend(),
+    }))
+    return 0
+
+
+def run_chaos_subprocess(timeout: float = 600.0):
+    return _run_flagged_subprocess("BENCH_CHAOS", timeout)
+
+
 def probe_device():
     """Probe backend/device kind in a throwaway subprocess so the parent never
     holds the TPU (a held chip would make every trial subprocess fail to init).
 
     A HUNG probe (observed: the axon tunnel relay dying outright — port 8083
     gone, jax.devices() blocking forever) must fail loudly with a diagnosis,
-    not crash the bench with a raw TimeoutExpired."""
+    not crash the bench with a raw TimeoutExpired. A dead relay sometimes
+    comes back within seconds (supervisor restart), so the probe retries
+    once with a short backoff before giving up."""
     code = (
         "import jax, json;"
         "d = jax.devices()[0];"
         "print(json.dumps({'backend': jax.default_backend(),"
         " 'kind': getattr(d, 'device_kind', '')}))"
     )
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True, timeout=300)
-    except subprocess.TimeoutExpired:
-        raise SystemExit(
-            "bench: device probe hung for 300 s — the accelerator transport "
-            "is wedged or its relay died (check that something listens on "
-            "127.0.0.1:8083). No benchable device; aborting.")
-    if proc.returncode != 0:
-        raise RuntimeError("device probe failed:\n" + proc.stderr[-2000:])
-    for line in reversed(proc.stdout.strip().splitlines()):
-        if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError("device probe produced no JSON")
+    last = None
+    for attempt in range(2):
+        if attempt:
+            print("bench: device probe failed; retrying once in 5 s "
+                  "(relay may be restarting)", file=sys.stderr)
+            time.sleep(5.0)
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True, timeout=300)
+        except subprocess.TimeoutExpired:
+            last = ("bench: device probe hung for 300 s — the accelerator "
+                    "transport is wedged or its relay died (check that "
+                    "something listens on 127.0.0.1:8083).")
+            continue
+        if proc.returncode != 0:
+            last = "device probe failed:\n" + proc.stderr[-2000:]
+            continue
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        last = "device probe produced no JSON"
+    raise SystemExit(
+        f"{last}\nNo benchable device after retry; aborting.")
 
 
 def _enable_jit_cache():
@@ -1240,13 +1473,24 @@ def main():
         if mode == ["decode-steady"]:
             result, err = run_decode_steady_subprocess()
             if result is None:
-                print(f"decode-steady bench failed:\n{err}", file=sys.stderr)
+                print(f"decode-steady bench failed:\n{_err_text(err)}",
+                      file=sys.stderr)
+                _fail_json(err)
                 return 1
             print(json.dumps(result))
             return 0
+        if mode == ["chaos"]:
+            result, err = run_chaos_subprocess()
+            if result is None:
+                print(f"chaos bench failed:\n{_err_text(err)}",
+                      file=sys.stderr)
+                _fail_json(err)
+                return 1
+            print(json.dumps(result))
+            return 0 if result.get("chaos_ok") else 1
         if mode != ["serving"]:
             print(f"bench: unknown --mode {mode or '(missing)'}; "
-                  "supported: serving, decode-steady", file=sys.stderr)
+                  "supported: serving, decode-steady, chaos", file=sys.stderr)
             return 2
         if "--shared-prefix-tokens" in sys.argv:
             # shared-prompt workload: prompts share an N-token prefix and
@@ -1259,13 +1503,18 @@ def main():
             os.environ["BENCH_SERVING_SHARED_PREFIX"] = val[0]
         result, err = run_serving_subprocess()
         if result is None:
-            print(f"serving bench failed:\n{err}", file=sys.stderr)
+            print(f"serving bench failed:\n{_err_text(err)}", file=sys.stderr)
+            _fail_json(err)
             return 1
         print(json.dumps(result))
         return 0
     if "--smoke" in sys.argv or os.environ.get("BENCH_SMOKE"):
         _enable_jit_cache()
         return smoke_main()
+    if os.environ.get("BENCH_CHAOS"):
+        # no jit cache: the chaos child runs a deliberately tiny model and
+        # must not pollute the shared compile cache with fault-path programs
+        return chaos_bench_main()
     if os.environ.get("BENCH_SERVING"):
         _enable_jit_cache()
         return serving_bench_main()
@@ -1291,28 +1540,33 @@ def main():
         smoke = (256, 688, 2, 512, 4, 2, 4, 64)
         result, err = run_trial_subprocess(smoke, steps=3)
         if result is None:
-            print(err, file=sys.stderr)
+            print(_err_text(err), file=sys.stderr)
+            _fail_json(err)
             return 1
         r3, err3 = run_trial_subprocess(smoke, steps=3, zero_stage=3)
         if r3 is not None:
             result["mfu_zero3"] = r3["value"]
         else:
-            print(f"stage-3 smoke trial failed:\n{err3}", file=sys.stderr)
+            print(f"stage-3 smoke trial failed:\n{_err_text(err3)}",
+                  file=sys.stderr)
         serve, errs = run_serve_subprocess()
         if serve is not None:
             result.update(serve)
         else:
-            print(f"serving smoke trial failed:\n{errs}", file=sys.stderr)
+            print(f"serving smoke trial failed:\n{_err_text(errs)}",
+                  file=sys.stderr)
         learn, errl = run_learn_subprocess()
         if learn is not None:
             result.update(learn)
         else:
-            print(f"learning smoke trial failed:\n{errl}", file=sys.stderr)
+            print(f"learning smoke trial failed:\n{_err_text(errl)}",
+                  file=sys.stderr)
         inf, erri = run_infinity_subprocess()
         if inf is not None:
             result.update(inf)
         else:
-            print(f"infinity smoke trial failed:\n{erri}", file=sys.stderr)
+            print(f"infinity smoke trial failed:\n{_err_text(erri)}",
+                  file=sys.stderr)
         print(json.dumps(result))
         return 0
 
@@ -1330,7 +1584,9 @@ def main():
                 int(e.get("BENCH_BATCH", 8)), int(e.get("BENCH_SEQ", 2048)))
         result, err = run_trial_subprocess(rung, steps=steps)
         if result is None:
-            print(f"pinned bench config {rung} failed:\n{err}", file=sys.stderr)
+            print(f"pinned bench config {rung} failed:\n{_err_text(err)}",
+                  file=sys.stderr)
+            _fail_json(err)
             return 1
         print(json.dumps(result))
         return 0
@@ -1357,36 +1613,39 @@ def main():
                 result["mfu_zero3"] = r3["value"]
                 result["tokens_per_s_zero3"] = r3.get("tokens_per_s")
             else:
-                print(f"stage-3 rung failed (headline unaffected):\n{err3}",
-                      file=sys.stderr)
+                print("stage-3 rung failed (headline unaffected):\n"
+                      + _err_text(err3), file=sys.stderr)
             # serving ladder rung: ragged continuous batching vs dense padding
             # (reference FastGen effective-throughput headline)
             serve, errs = run_serve_subprocess()
             if serve is not None:
                 result.update(serve)
             else:
-                print(f"serving trial failed (headline unaffected):\n{errs}",
-                      file=sys.stderr)
+                print("serving trial failed (headline unaffected):\n"
+                      + _err_text(errs), file=sys.stderr)
             # learning-evidence rung: real-text byte LM, loss must descend
             learn, errl = run_learn_subprocess()
             if learn is not None:
                 result.update(learn)
             else:
-                print(f"learning trial failed (headline unaffected):\n{errl}",
-                      file=sys.stderr)
+                print("learning trial failed (headline unaffected):\n"
+                      + _err_text(errl), file=sys.stderr)
             # ZeRO-Infinity rung: fp32 training state > HBM, host-resident
             # masters streamed per layer/sub-group (round-4 item 1)
             inf, erri = run_infinity_subprocess()
             if inf is not None:
                 result.update(inf)
             else:
-                print(f"infinity trial failed (headline unaffected):\n{erri}",
-                      file=sys.stderr)
+                print("infinity trial failed (headline unaffected):\n"
+                      + _err_text(erri), file=sys.stderr)
             print(json.dumps(result))
             return 0
-        errors.append(f"config {rung}: {err[-300:] if err else 'unknown'}")
-        print(f"bench rung {rung} failed, backing off:\n{err}", file=sys.stderr)
+        errors.append(
+            f"config {rung}: {_err_text(err)[-300:] if err else 'unknown'}")
+        print(f"bench rung {rung} failed, backing off:\n{_err_text(err)}",
+              file=sys.stderr)
     print("all bench rungs failed:\n" + "\n".join(errors), file=sys.stderr)
+    _fail_json({"reason": "all bench rungs failed", "rungs": errors})
     return 1
 
 

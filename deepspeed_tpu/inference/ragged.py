@@ -25,6 +25,7 @@ drop-in optimization point.
 
 from __future__ import annotations
 
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -34,6 +35,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.models.api import ModelSpec, ShardCtx
+from deepspeed_tpu.serving.faults import (
+    POINT_ALLOC,
+    POINT_DISPATCH,
+    POINT_H2D,
+    POINT_READBACK,
+    classify_transient,
+    get_fault_injector,
+)
 from deepspeed_tpu.telemetry import get_telemetry
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -195,6 +204,23 @@ class RaggedConfig:
     # False restores the legacy host-staged dispatch path (token-identical;
     # kept as the parity baseline and an escape hatch).
     device_state: bool = True
+    # ---- dispatch watchdog (docs/FAULT_TOLERANCE.md) ----
+    # wall-clock budget for one step(); a step exceeding it counts toward
+    # the degradation ladder like a transient failure (the device path is
+    # limping even though it completed). 0 disables the deadline check.
+    step_deadline_s: float = 0.0
+    # transient step failures retried in place (with backoff) before the
+    # error escalates out of step(); fatal errors never retry
+    dispatch_retries: int = 2
+    retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    # multiplicative jitter on each backoff sleep, drawn from an
+    # engine-seeded RNG so a replayed run backs off identically
+    retry_jitter: float = 0.25
+    # consecutive device-path failures that trigger automatic degradation:
+    # device-resident state -> host-staged kill-switch path -> plain-step
+    # fallback (token-identical rungs). 0 disables degradation.
+    degrade_after: int = 3
     # block-level prefix caching (SGLang/vLLM-style): retired sequences
     # publish their full prompt blocks into a hash-chained index; admission
     # splices the longest cached full-block prefix into a new sequence's
@@ -457,6 +483,18 @@ class RaggedInferenceEngine:
         # cache is internal to jax (no explicit program dict to probe)
         self._chunk_keys: set = set()
         self._step_keys: set = set()
+        # ---- dispatch watchdog (docs/FAULT_TOLERANCE.md) ----
+        # degraded_mode: 0 = full configured path, 1 = host-staged fallback
+        # (device_state flipped off), 2 = plain-step fallback (fused/run-
+        # ahead/tiles disabled). Every rung is token-identical; the ladder
+        # trades dispatch efficiency for a smaller failure surface.
+        self._faults = get_fault_injector()
+        self._retry_rng = random.Random(self._engine_seed ^ 0x5EED)
+        self.degraded_mode = 0
+        self.degraded_reason: str | None = None
+        self.step_failures = 0   # transient device-path failures observed
+        self.step_retries = 0    # in-place retries the watchdog issued
+        self._consec_failures = 0
         log_dist(
             f"RaggedInferenceEngine: model={self.spec.name} "
             f"budget={self.cfg.max_tokens_per_step} max_seqs={self.cfg.max_seqs} "
@@ -512,6 +550,11 @@ class RaggedInferenceEngine:
         else:
             eff_seed = int(seed) & 0x7FFFFFFF
         self._put_counter += 1
+        # re-putting a retired uid supersedes its old record (idempotent
+        # failover resubmission: the router replays a request that died with
+        # its replica; get_request/_results must reflect the live attempt,
+        # not the stale error)
+        self._results.pop(uid, None)
         if self._tracer.enabled:
             # seq.trace is the request's umbrella "engine/request" span:
             # a child of the serving root when one was threaded in, or a
@@ -669,6 +712,8 @@ class RaggedInferenceEngine:
             return False
         if len(seq.blocks) + need > self.cfg.max_blocks_per_seq:
             return False
+        if self._faults.enabled:
+            self._faults.fire(POINT_ALLOC, request_id=str(seq.uid))
         new = self.allocator.allocate(need)
         start = len(seq.blocks)
         seq.blocks.extend(new)
@@ -807,9 +852,13 @@ class RaggedInferenceEngine:
     def _write_slot_row(self, seq: _SeqState) -> None:
         """Admission hook: write one slot's persistent device row in place
         (donated updater; ~32 bytes H2D instead of per-step re-packing).
-        ``pos`` starts past any spliced cached prefix; ``tok`` is reset —
-        the prompt-completing dispatch publishes the first feed token."""
-        iv = np.asarray([0, seq.pos, seq.seed, len(seq.prompt), seq.top_k],
+        ``pos`` starts past any spliced cached prefix; at admission ``tok``
+        is reset (the prompt-completing dispatch publishes the first feed
+        token). When the watchdog rebuilds a mid-decode sequence's row,
+        ``pos`` is already past the prompt and the host-known token at that
+        position seeds the device feed instead."""
+        tok = seq.token_at(seq.pos) if seq.pos >= len(seq.prompt) else 0
+        iv = np.asarray([tok, seq.pos, seq.seed, len(seq.prompt), seq.top_k],
                         np.int32)
         fv = np.asarray([seq.temperature, seq.top_p], np.float32)
         self.h2d_bytes += iv.nbytes + fv.nbytes + 4
@@ -843,6 +892,8 @@ class RaggedInferenceEngine:
         this size — the steady-decode case: slots/flags planes are static
         across steps and tokens/positions live on device, so the whole
         buffer byte-compares equal."""
+        if self._faults.enabled:
+            self._faults.fire(POINT_H2D)
         arr = np.ascontiguousarray(arr, np.int32)
         raw = arr.tobytes()
         hit = self._staging_cache.get(arr.shape[0])
@@ -857,6 +908,8 @@ class RaggedInferenceEngine:
         """Legacy-path upload helper: jnp.asarray + H2D byte accounting, so
         the host-staged and device-resident paths report comparable
         ``h2d_bytes`` to the bench and telemetry."""
+        if self._faults.enabled:
+            self._faults.fire(POINT_H2D)
         self.h2d_bytes += arr.nbytes
         return jnp.asarray(arr)
 
@@ -1062,6 +1115,8 @@ class RaggedInferenceEngine:
         fn = self._get_dev_chunk(k, bucket, self._table_width(max_pos),
                                  sampled, sampled and has_tk,
                                  sampled and has_tp)
+        if self._faults.enabled:
+            self._faults.fire(POINT_DISPATCH)
         out, self._dev_state, self.cache = fn(
             self.params, self.cache, self._dev_state, self._bt_dev, staged,
             self._sample_root)
@@ -1195,6 +1250,8 @@ class RaggedInferenceEngine:
         staged = self._stage(np.concatenate(parts))
         fn = self._get_dev_step(t_total, nd, nt, self._table_width(max_pos),
                                 sampled, has_tk, has_tp)
+        if self._faults.enabled:
+            self._faults.fire(POINT_DISPATCH)
         picked, self._dev_state, self.cache = fn(
             self.params, self.cache, self._dev_state, self._bt_dev, staged,
             self._sample_root)
@@ -1217,6 +1274,8 @@ class RaggedInferenceEngine:
         into host state (EOS/max_new enforcement via ``_append_tokens``;
         release deferred until a sequence's last pending reference
         drains — the non-fused modes' double-buffer reconcile)."""
+        if self._faults.enabled:
+            self._faults.fire(POINT_READBACK)
         rec = self._pending.pop(0)
         t0 = time.perf_counter()
         out: dict = {}
@@ -1322,6 +1381,8 @@ class RaggedInferenceEngine:
                 self._table_width(max_pos))
         self._note_program("chunk", ckey not in self._chunk_keys)
         self._chunk_keys.add(ckey)
+        if self._faults.enabled:
+            self._faults.fire(POINT_DISPATCH)
         out, self.cache = self._chunk_jit(
             k, sampled, has_tk, has_tp,
             self.params, self.cache,
@@ -1788,6 +1849,8 @@ class RaggedInferenceEngine:
         fn = self._get_fused_chunk(k, nd, nt, sampled,
                                    bool(topk.any()),
                                    bool((topp < 1.0).any()))
+        if self._faults.enabled:
+            self._faults.fire(POINT_DISPATCH)
         dec_toks, tok0, self._slot_toks, self.cache = fn(
             self.params, self.cache, self._slot_toks,
             self._h2d(tokens), self._h2d(slots), self._h2d(positions),
@@ -1996,6 +2059,8 @@ class RaggedInferenceEngine:
         fn = self._get_dev_fused(max(t_total, 1), k, nd, nt,
                                  self._table_width(max_pos), sampled,
                                  sampled and has_tk, sampled and has_tp)
+        if self._faults.enabled:
+            self._faults.fire(POINT_DISPATCH)
         dec_toks, tok0, self._dev_state, self.cache = fn(
             self.params, self.cache, self._dev_state, self._bt_dev, staged,
             self._sample_root)
@@ -2041,6 +2106,8 @@ class RaggedInferenceEngine:
     def _reconcile_oldest(self) -> dict:
         """Read back the OLDEST in-flight chunk's tokens and fold them into
         host state (EOS/max_new enforcement, deferred release)."""
+        if self._faults.enabled:
+            self._faults.fire(POINT_READBACK)
         rec = self._inflight_chunks.pop(0)
         t0 = time.perf_counter()
         dec_toks = np.asarray(rec["dec_toks"])
@@ -2194,6 +2261,8 @@ class RaggedInferenceEngine:
         ones."""
         out: dict = {}
         if emit:
+            if self._faults.enabled:
+                self._faults.fire(POINT_READBACK)
             t0 = time.perf_counter()
             idx = np.asarray([i for i, _ in emit])
             if any(seq.temperature > 0.0 for _, seq in emit):
@@ -2258,14 +2327,209 @@ class RaggedInferenceEngine:
                 "lower max_seqs/max_new_tokens"
             )
 
+    # ------------------------------------------------- dispatch watchdog
+    def _recover_device_path(self) -> None:
+        """Re-anchor the engine on host ground truth after a failed step:
+        discard ALL unread speculation (pending readbacks + in-flight fused
+        chunks — partially draining them could interleave token order) and
+        rewind every running sequence's schedule position to what its
+        host-visible ``generated`` list proves was delivered. Re-running
+        the discarded positions rewrites identical KV and — because token
+        ``g`` of a request samples from a key derived only from (seed, g) —
+        re-picks identical tokens, so recovery is invisible in the output
+        stream. Injected faults fire BEFORE a jitted call consumes its
+        donated buffers, and a real mid-execution failure raises out of the
+        dispatch before the host bindings are swapped, so cache/state
+        references here are the pre-dispatch values."""
+        self._pending.clear()
+        self._inflight_chunks.clear()
+        self._staging_cache.clear()
+        self._slot_feed[:] = False
+        for seq in self._running.values():
+            seq.refs = 0
+            g = len(seq.generated)
+            if g:
+                # decode invariant: feeding token_at(pos) at position pos
+                # produces generated index pos - len(prompt) + 1
+                seq.pos = len(seq.prompt) + g - 1
+            elif seq.pos >= len(seq.prompt):
+                # prompt fully scheduled but its first token never landed:
+                # re-run the final prompt position (>= cached_prefix, so
+                # shared prefix blocks are never rewritten)
+                seq.pos = len(seq.prompt) - 1
+            else:
+                # mid-prefill: re-prefill the uncached tail (idempotent)
+                seq.pos = seq.cached_prefix
+        # device mirrors are stale by construction now: rebuild the block
+        # table wholesale and re-seed the slot rows from host truth
+        self._bt_dirty.clear()
+        self._bt_dev = jnp.asarray(self.block_tables)
+        if self.cfg.device_state:
+            for seq in self._running.values():
+                self._write_slot_row(seq)
+        # sequences whose release was deferred on in-flight refs would
+        # otherwise never retire (every scheduler loop skips finished seqs)
+        for seq in list(self._running.values()):
+            if seq.finished:
+                self._release(seq)
+
+    def _maybe_degrade(self, exc: Exception) -> bool:
+        """Walk one rung down the degradation ladder once failures repeat:
+        full device-resident path -> host-staged kill-switch path
+        (``device_state`` off) -> plain single-program SplitFuse step
+        (fused/run-ahead/tiles off). Returns True when a rung was taken;
+        every rung is token-identical (pinned by the mode-parity tests), so
+        degradation costs dispatch efficiency, never output."""
+        cfg = self.cfg
+        if not cfg.degrade_after or self._consec_failures < cfg.degrade_after:
+            return False
+        reason = f"{type(exc).__name__}: {exc}"
+        if cfg.device_state:
+            cfg.device_state = False
+            self.degraded_mode = 1
+            rung = "host-staged fallback (device_state off)"
+        elif (cfg.fused_chunk or cfg.decode_run_ahead or cfg.prefill_tile
+              or self._use_tiles):
+            cfg.fused_chunk = 0
+            cfg.decode_run_ahead = 0
+            cfg.prefill_tile = 0
+            self._use_tiles = False
+            self.degraded_mode = 2
+            rung = "plain-step fallback (fused/run-ahead/tiles off)"
+        else:
+            return False  # already at the bottom rung
+        self.degraded_reason = reason
+        self._consec_failures = 0
+        log_dist(
+            f"ragged watchdog: degrading to {rung} after repeated "
+            f"device-path failures ({reason})", ranks=[0])
+        tel = self.telemetry
+        if tel.enabled:
+            tel.gauge(
+                "degraded_mode",
+                "0 full | 1 host-staged fallback | 2 plain-step fallback",
+            ).set(self.degraded_mode)
+            tel.event("inference/degraded", mode=self.degraded_mode,
+                      reason=reason)
+        return True
+
+    def _backoff(self, attempt: int) -> None:
+        cfg = self.cfg
+        base = min(cfg.retry_backoff_max_s,
+                   cfg.retry_backoff_s * (2 ** (attempt - 1)))
+        time.sleep(base * (1.0 + cfg.retry_jitter * self._retry_rng.random()))
+
+    def _step_watched(self) -> dict:
+        """Run ``_step_impl`` under the dispatch watchdog: transient
+        failures (see ``faults.classify_transient``) recover host state and
+        retry in place with exponential backoff + jitter; repeated failure
+        walks the degradation ladder (each rung resets the retry budget);
+        fatal errors and an exhausted budget escalate to the caller (the
+        engine loop's crash containment)."""
+        cfg = self.cfg
+        attempts = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                out = self._step_impl()
+            except Exception as e:
+                if not classify_transient(e):
+                    raise
+                attempts += 1
+                self.step_failures += 1
+                self._consec_failures += 1
+                if self.telemetry.enabled:
+                    self.telemetry.counter(
+                        "dispatch_retries_total",
+                        "transient step failures recovered by the "
+                        "watchdog").inc(kind=type(e).__name__)
+                log_dist(
+                    f"ragged watchdog: transient step failure "
+                    f"({type(e).__name__}: {e}); attempt {attempts}",
+                    ranks=[0])
+                self._recover_device_path()
+                if self._maybe_degrade(e):
+                    attempts = 0  # a fresh rung gets a fresh retry budget
+                    continue
+                if attempts > max(0, cfg.dispatch_retries):
+                    raise
+                self.step_retries += 1
+                self._backoff(attempts)
+                continue
+            if cfg.step_deadline_s and \
+                    time.perf_counter() - t0 > cfg.step_deadline_s:
+                # the step completed but blew its wall-clock budget: the
+                # work is kept, yet it counts toward degradation — a
+                # limping device path should fall back before it stalls
+                # the whole serving loop
+                self._consec_failures += 1
+                if self.telemetry.enabled:
+                    self.telemetry.counter(
+                        "dispatch_deadline_exceeded_total",
+                        "steps exceeding cfg.step_deadline_s").inc()
+                self._maybe_degrade(TimeoutError(
+                    f"step exceeded deadline {cfg.step_deadline_s:g}s"))
+            else:
+                self._consec_failures = 0
+            return out
+
+    def reset_state(self) -> int:
+        """Crash containment (serving/engine_loop.py): rebuild every piece
+        of mutable engine state after a poisoned step — fresh KV cache and
+        allocator, zeroed block tables and device mirrors — keeping params
+        and all compiled programs. Every queued/running request is retired
+        with ``status='error'`` (the loop surfaces structured errors for
+        them); returns how many were failed."""
+        failed = 0
+        for seq in (*self._queued, *self._running.values()):
+            seq.status = "error"
+            seq.blocks = []
+            seq.reserved_remaining = 0
+            seq.refs = 0
+            seq.slot = -1
+            self._results[seq.uid] = seq
+            failed += 1
+            if self.telemetry.enabled:
+                self._emit_request_span(seq)
+        self._queued = []
+        self._running = {}
+        self._pending.clear()
+        self._inflight_chunks.clear()
+        self._staging_cache.clear()
+        self.allocator = BlockedAllocator(self.cfg.num_blocks)
+        self.block_tables[:] = 0
+        self._bt_dirty.clear()
+        self._bt_dev = jnp.asarray(self.block_tables)
+        self._free_slots = list(range(self.cfg.max_seqs - 1, -1, -1))
+        self._reserved = 0
+        self._slot_feed[:] = False
+        s1 = self.cfg.max_seqs + 1
+        self._slot_toks = jnp.zeros(s1, jnp.int32)
+        self._dev_state = (
+            jnp.zeros(s1, jnp.int32), jnp.zeros(s1, jnp.int32),
+            jnp.zeros(s1, jnp.int32), jnp.zeros(s1, jnp.int32),
+            jnp.zeros(s1, jnp.float32), jnp.zeros(s1, jnp.int32),
+            jnp.ones(s1, jnp.float32),
+        )
+        self.cache = self.spec.init_paged_cache_fn(
+            self.cfg.num_blocks, self.cfg.block_size, self.dtype)
+        self._consec_failures = 0
+        if failed:
+            log_dist(
+                f"ragged engine: state reset failed {failed} in-flight "
+                "request(s)", ranks=[0])
+        return failed
+
     def step(self) -> dict:
         """One SplitFuse step. Returns {uid: token} for sequences that emitted
         a token this step (under decode run-ahead / the fused pipeline: the
         LAST token of each sequence's chunk; the full stream is in the
-        per-sequence state)."""
+        per-sequence state). Runs under the dispatch watchdog: transient
+        device-path failures are retried (and eventually degraded) in
+        place, so callers only ever see fatal errors."""
         if not self.has_work:
             return {}
-        out = self._step_impl()
+        out = self._step_watched()
         if self.telemetry.enabled:
             self._sample_step_telemetry()
         return out
@@ -2291,6 +2555,9 @@ class RaggedInferenceEngine:
             self.tokens_padded)
         g("inference_dispatch_count", "device dispatches issued").set(
             self.dispatch_count)
+        g("degraded_mode",
+          "0 full | 1 host-staged fallback | 2 plain-step fallback").set(
+              self.degraded_mode)
         if self.h2d_bytes > self._h2d_seen:
             tel.counter(
                 "ragged_h2d_bytes_total",
@@ -2388,6 +2655,8 @@ class RaggedInferenceEngine:
         skey = ("step", bucket, self._table_width(max_pos))
         self._note_program("step", skey not in self._step_keys)
         self._step_keys.add(skey)
+        if self._faults.enabled:
+            self._faults.fire(POINT_DISPATCH)
         logits, self.cache = self._step_jit(
             self.params, self.cache,
             self._h2d(tokens[:bucket]), self._h2d(slots[:bucket]),
@@ -2473,6 +2742,8 @@ class RaggedInferenceEngine:
 
         step_fn = self._get_tiled_step(nd, nt)
         max_pos = int(positions[:total].max(initial=0)) if total else 0
+        if self._faults.enabled:
+            self._faults.fire(POINT_DISPATCH)
         logits, self.cache = step_fn(
             self.params, self.cache,
             self._h2d(tokens[:total]), self._h2d(slots[:total]),

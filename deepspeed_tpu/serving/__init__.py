@@ -12,6 +12,9 @@ MII-role tier, stdlib-only:
   control + bounded queues (429 backpressure)
 - :mod:`frontend` — ``http.server`` HTTP surface: ``POST /v1/completions``
   (JSON + SSE), ``GET /healthz``, ``GET /metrics``
+- :mod:`faults` — deterministic fault-injection harness (named injection
+  points at the real seams; drives the dispatch watchdog, crash
+  containment, and replica-failover machinery — docs/FAULT_TOLERANCE.md)
 
 See docs/SERVING.md for the architecture walkthrough.
 """
@@ -39,7 +42,22 @@ from deepspeed_tpu.serving.protocol import (  # noqa: F401
     encode_sse,
     sse_done,
 )
+from deepspeed_tpu.serving.faults import (  # noqa: F401
+    POINT_ALLOC,
+    POINT_DISPATCH,
+    POINT_H2D,
+    POINT_LOOP,
+    POINT_READBACK,
+    POINT_SUBMIT,
+    FatalFaultError,
+    FaultError,
+    FaultInjector,
+    FaultSpec,
+    classify_transient,
+    get_fault_injector,
+)
 from deepspeed_tpu.serving.router import (  # noqa: F401
+    DeadlineExceeded,
     Draining,
     Overloaded,
     ReplicaRouter,

@@ -26,6 +26,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from deepspeed_tpu.serving.faults import POINT_LOOP, get_fault_injector
 from deepspeed_tpu.serving.protocol import (
     FINISH_CANCELLED,
     FINISH_LENGTH,
@@ -56,6 +57,12 @@ class TokenStream:
         self.request_id = request_id
         self.finish_reason: str | None = None
         self.error: str | None = None
+        # structured failure detail: an HTTP-equivalent status and a
+        # machine-readable reason ("replica_died", "engine_crash",
+        # "deadline", ...) so the frontend can map the error to the right
+        # response and the router can decide whether failover is sound
+        self.error_code: int | None = None
+        self.error_reason: str | None = None
         self._q: queue.SimpleQueue = queue.SimpleQueue()
 
     # ---------------------------------------------- producer (loop thread)
@@ -66,8 +73,11 @@ class TokenStream:
         self.finish_reason = reason
         self._q.put(("done", reason))
 
-    def _fail(self, message: str) -> None:
+    def _fail(self, message: str, code: int | None = None,
+              reason: str | None = None) -> None:
         self.error = message
+        self.error_code = code
+        self.error_reason = reason
         self._q.put(("error", message))
 
     # ---------------------------------------------------------- consumer
@@ -117,6 +127,9 @@ class ReplicaStats:
     usable_blocks: int        # pool size minus the scratch block
     max_request_blocks: int   # per-request block ceiling (put() rejects past it)
     max_request_tokens: int   # engine max_seq_len
+    degraded: int = 0         # engine degraded_mode rung (0 = full path)
+    crashes: int = 0          # step exceptions contained by the loop
+    respawns: int = 0         # loop-thread deaths survived by respawn
 
     def worst_blocks(self, total_tokens: int) -> int:
         return -(-total_tokens // self.block_size)
@@ -136,10 +149,19 @@ class EngineLoop:
     """Background driver for one RaggedInferenceEngine replica."""
 
     def __init__(self, engine, name: str = "replica-0",
-                 idle_wait_s: float = 0.002):
+                 idle_wait_s: float = 0.002, max_respawns: int = 3):
         self._engine = engine
         self.name = name
         self._idle_wait_s = float(idle_wait_s)
+        self._max_respawns = int(max_respawns)
+        self._faults = get_fault_injector()
+        # fault-tolerance counters: crash_count = step exceptions contained
+        # in-place (affected requests failed, engine state rebuilt, loop
+        # keeps running); respawn_count = loop-thread deaths survived by
+        # starting a replacement thread
+        self.crash_count = 0
+        self.respawn_count = 0
+        self._consec_crashes = 0
         self._lock = threading.Lock()
         self._inbox: list = []       # heap of (priority, seqno, req, stream)
         self._seqno = itertools.count()
@@ -179,8 +201,13 @@ class EngineLoop:
         self._wake.set()
 
     def join(self, timeout: float | None = None) -> bool:
-        """Wait for the loop to exit (after ``begin_drain``)."""
-        if not self._thread.is_alive():
+        """Wait for the loop to exit (after ``begin_drain``). Waits on the
+        ``_stopped`` event, not the thread handle: a respawn swaps
+        ``self._thread`` for a replacement, and only final death (or clean
+        drain) sets ``_stopped``."""
+        if self._stopped.is_set():
+            return True
+        if self._thread.ident is None:  # never started: nothing will run
             return True
         return self._stopped.wait(timeout)
 
@@ -202,6 +229,10 @@ class EngineLoop:
         lower first). Raises ReplicaDraining after ``begin_drain``."""
         if self._draining.is_set():
             raise ReplicaDraining(f"{self.name} is draining")
+        if not req.t_submit:
+            # stamp here (not only in the frontend) so deadline-aware inbox
+            # shedding measures queue wait for direct submitters too
+            req.t_submit = time.perf_counter()
         stream = TokenStream(req.request_id)
         with self._lock:
             heapq.heappush(
@@ -249,7 +280,9 @@ class EngineLoop:
             free_blocks=free, pending_blocks=pending_blocks,
             block_size=self._block_size, usable_blocks=self._usable_blocks,
             max_request_blocks=self._max_request_blocks,
-            max_request_tokens=self._max_request_tokens)
+            max_request_tokens=self._max_request_tokens,
+            degraded=int(getattr(self._engine, "degraded_mode", 0)),
+            crashes=self.crash_count, respawns=self.respawn_count)
 
     # ------------------------------------------------------- loop internals
     def _drain_inbox(self) -> None:
@@ -263,6 +296,20 @@ class EngineLoop:
             if rid in cancels:
                 cancels.discard(rid)
                 stream._finish(FINISH_CANCELLED)
+            elif (req.deadline_s is not None and req.t_submit
+                  and time.perf_counter() - req.t_submit >= req.deadline_s):
+                # deadline already burned in the inbox: shed instead of
+                # dispatching doomed work (504-equivalent structured error)
+                stream._fail(
+                    f"request {rid}: deadline_s={req.deadline_s} expired "
+                    f"before placement on {self.name}",
+                    code=504, reason="deadline")
+                tel = get_telemetry()
+                if tel.enabled:
+                    tel.counter(
+                        "serving_requests_shed_total",
+                        "expired-deadline requests shed pre-placement",
+                    ).inc(replica=self.name)
             else:
                 if req.trace_ctx is not None and req.t_submit:
                     # frontend submit → loop-thread pickup: the cross-thread
@@ -328,36 +375,110 @@ class EngineLoop:
             len(eng._queued), len(eng._running), outstanding,
             eng.allocator.free_blocks - eng._reserved)
 
-    def _run(self) -> None:
-        eng = self._engine
+    def _contain(self, exc: Exception) -> None:
+        """Crash containment for one failed ``engine.step()``: fail only the
+        affected requests with a structured error, rebuild the poisoned
+        engine state, and keep the loop running. Repeated back-to-back
+        crashes escalate to loop death (handled by ``_run``'s respawn)."""
+        self.crash_count += 1
+        self._consec_crashes += 1
+        if self._consec_crashes > self._max_respawns:
+            raise exc  # containment is not converging — escalate
+        msg = (f"engine step crashed on {self.name}: "
+               f"{type(exc).__name__}: {exc}")
+        log_dist(f"{msg} (contained; rebuilding engine state)", ranks=[0])
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter(
+                "engine_loop_crashes_total",
+                "engine.step() exceptions contained by the loop",
+            ).inc(replica=self.name)
         try:
-            while True:
-                self._drain_inbox()
-                if eng.has_work:
+            self._deliver()  # flush tokens/finishes that predate the crash
+        except Exception:  # noqa: BLE001 - engine state may be poisoned
+            pass
+        for op in self._open.values():
+            op.stream._fail(msg, code=500, reason="engine_crash")
+        self._open.clear()
+        self._engine.reset_state()
+        self._publish_stats()
+
+    def _run_loop(self) -> None:
+        eng = self._engine
+        while True:
+            self._drain_inbox()
+            if eng.has_work:
+                if self._faults.enabled:
+                    # outside the try: an injected loop fault kills the
+                    # thread (exercising respawn), engine faults exercise
+                    # containment. Idle replicas never reach this point,
+                    # which keeps chaos schedules deterministic.
+                    self._faults.fire(POINT_LOOP)
+                try:
                     eng.step()
-                    self._deliver()
-                    self._publish_stats()
-                    continue
+                except Exception as e:  # noqa: BLE001 - contain, don't die
+                    self._contain(e)
+                else:
+                    self._consec_crashes = 0
                 self._deliver()
                 self._publish_stats()
-                with self._lock:
-                    idle = not self._inbox and not self._cancel_ids
-                if idle and self._draining.is_set():
-                    break
-                self._wake.wait(self._idle_wait_s)
-                self._wake.clear()
+                continue
+            self._deliver()
+            self._publish_stats()
+            with self._lock:
+                idle = not self._inbox and not self._cancel_ids
+            if idle and self._draining.is_set():
+                return
+            self._wake.wait(self._idle_wait_s)
+            self._wake.clear()
+
+    def _fail_all(self, msg: str, code: int, reason: str) -> None:
+        for op in self._open.values():
+            op.stream._fail(msg, code=code, reason=reason)
+        self._open.clear()
+        with self._lock:
+            items, self._inbox = self._inbox, []
+            self._pending_blocks = self._pending_tokens = 0
+        for _, _, _, stream in items:
+            stream._fail(msg, code=code, reason=reason)
+
+    def _run(self) -> None:
+        try:
+            self._run_loop()
         except Exception as e:  # noqa: BLE001 - the loop IS the failure domain
             self.error = f"{type(e).__name__}: {e}"
             log_dist(f"engine loop {self.name} died: {self.error}", ranks=[0])
-            for op in self._open.values():
-                op.stream._fail(self.error)
-            self._open.clear()
-            with self._lock:
-                items, self._inbox = self._inbox, []
-                self._pending_blocks = self._pending_tokens = 0
-            for _, _, _, stream in items:
-                stream._fail(self.error)
-        finally:
-            self._alive = False
-            self._draining.set()  # a dead replica must not admit
-            self._stopped.set()
+            self._fail_all(self.error, code=503, reason="replica_died")
+            if (not self._draining.is_set()
+                    and self.respawn_count < self._max_respawns):
+                # respawn rather than silently dying: rebuild the engine,
+                # start a replacement thread, and leave _alive/_stopped
+                # untouched so the replica stays routable
+                try:
+                    self._engine.reset_state()
+                except Exception as re:  # noqa: BLE001 - rebuild failed
+                    log_dist(f"engine loop {self.name}: state rebuild after "
+                             f"death failed ({re}); staying down", ranks=[0])
+                else:
+                    self.respawn_count += 1
+                    self._consec_crashes = 0
+                    tel = get_telemetry()
+                    if tel.enabled:
+                        tel.counter(
+                            "engine_loop_respawns_total",
+                            "engine-loop threads respawned after death",
+                        ).inc(replica=self.name)
+                    log_dist(f"engine loop {self.name}: respawning thread "
+                             f"({self.respawn_count}/{self._max_respawns})",
+                             ranks=[0])
+                    self._thread = threading.Thread(
+                        target=self._run,
+                        name=f"engine-loop-{self.name}-r{self.respawn_count}",
+                        daemon=True)
+                    self._thread.start()
+                    return  # replacement owns the engine now
+        # clean drain exit, or final death (respawn budget spent / rebuild
+        # failed / draining)
+        self._alive = False
+        self._draining.set()  # a dead replica must not admit
+        self._stopped.set()

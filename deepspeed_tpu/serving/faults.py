@@ -1,0 +1,234 @@
+"""Deterministic fault injection for the serving + inference path.
+
+A process-local :class:`FaultInjector` singleton exposes **named injection
+points** at the real seams of the stack — device dispatch, H2D upload,
+token readback, block allocation, the engine-loop iteration, and the
+router→replica submit edge. Production code calls ``fire(point)`` at each
+seam; with no faults armed this is a single attribute check and the hot
+paths pay nothing. Tests, ``bench.py --mode chaos``, and CI arm a
+*schedule* of :class:`FaultSpec` entries, each of which fires
+deterministically by hit count (``after`` / ``every`` / ``times``) or per
+request (``request_id``), so a failing run replays exactly.
+
+Three fault kinds:
+
+- ``raise`` — raise :class:`FaultError` (transient) or
+  :class:`FatalFaultError` (``fatal=True``) at the seam.
+- ``hang`` — sleep ``delay_s`` then raise ``TimeoutError`` (models a wedged
+  transfer surfacing as a deadline).
+- ``latency`` — sleep ``delay_s`` and continue (slow path, no error).
+
+``classify_transient`` is the shared error taxonomy used by the dispatch
+watchdog (inference/ragged.py) and the router breaker: injected transient
+faults, timeouts, connection drops, and XLA "try again" statuses retry;
+everything else is fatal and escalates. See docs/FAULT_TOLERANCE.md.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from deepspeed_tpu.telemetry import get_telemetry
+
+# Named injection points (the real seams).
+POINT_DISPATCH = "engine.dispatch"   # jitted step/chunk/fused program launch
+POINT_H2D = "engine.h2d"             # host→device staging upload
+POINT_READBACK = "engine.readback"   # device→host token/logits readback
+POINT_ALLOC = "engine.alloc"         # KV block allocation
+POINT_LOOP = "loop.step"             # engine-loop thread, once per busy tick
+POINT_SUBMIT = "router.submit"       # router→replica submit edge
+
+POINTS = (
+    POINT_DISPATCH,
+    POINT_H2D,
+    POINT_READBACK,
+    POINT_ALLOC,
+    POINT_LOOP,
+    POINT_SUBMIT,
+)
+
+
+class FaultError(RuntimeError):
+    """An injected failure. ``transient`` mirrors the real-world class the
+    injection models (a retryable transfer/dispatch error)."""
+
+    transient = True
+
+    def __init__(self, message: str, point: str = ""):
+        super().__init__(message)
+        self.point = point
+
+
+class FatalFaultError(FaultError):
+    """An injected non-retryable failure (poisoned state, bad program)."""
+
+    transient = False
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault. Firing is counted per spec: the spec matches the
+    ``hits``-th eligible call when ``hits > after``, ``(hits - after - 1)``
+    is a multiple of ``every``, and fewer than ``times`` firings have
+    happened (``times=0`` = unlimited)."""
+
+    point: str
+    kind: str = "raise"              # raise | hang | latency
+    after: int = 0                   # skip this many eligible hits first
+    times: int = 1                   # max firings (0 = unlimited)
+    every: int = 1                   # then fire every N-th eligible hit
+    request_id: str | None = None    # only hits carrying this request id
+    delay_s: float = 0.05            # hang/latency sleep
+    fatal: bool = False              # raise FatalFaultError instead
+    probability: float = 1.0         # eligible-hit firing probability
+    message: str = ""
+    hits: int = field(default=0, init=False)
+    fired: int = field(default=0, init=False)
+
+    def __post_init__(self):
+        if self.point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {self.point!r} (known: {POINTS})")
+        if self.kind not in ("raise", "hang", "latency"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+class FaultInjector:
+    """Deterministic, seedable fault scheduler (module singleton below).
+
+    Off by default: ``fire()`` returns immediately unless ``enabled``.
+    Thread-safe — the engine loop, HTTP handler threads, and the router
+    all fire through the one instance.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self._specs: list[FaultSpec] = []
+        self._rng = random.Random(0)
+        self._fired: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- arming
+    def configure(self, specs, seed: int = 0) -> "FaultInjector":
+        """Arm a schedule: a list of :class:`FaultSpec` or plain dicts
+        (JSON-loadable, as used by ``bench.py --mode chaos``)."""
+        with self._lock:
+            self._specs = [
+                s if isinstance(s, FaultSpec) else FaultSpec(**s)
+                for s in (specs or [])
+            ]
+            self._rng = random.Random(seed)
+            self._fired = {}
+            self.enabled = bool(self._specs)
+        return self
+
+    def arm(self, point: str, **kw) -> FaultSpec:
+        """Arm one additional fault at ``point``."""
+        spec = FaultSpec(point=point, **kw)
+        with self._lock:
+            self._specs.append(spec)
+            self.enabled = True
+        return spec
+
+    def reset(self) -> None:
+        """Disarm everything (test isolation; conftest calls this)."""
+        with self._lock:
+            self._specs = []
+            self._fired = {}
+            self._rng = random.Random(0)
+            self.enabled = False
+
+    # ------------------------------------------------------------- firing
+    def fire(self, point: str, request_id: str | None = None) -> None:
+        """Called by production code at the named seam. No-op unless a
+        matching armed spec elects this hit."""
+        if not self.enabled:
+            return
+        spec = None
+        with self._lock:
+            for s in self._specs:
+                if s.point != point:
+                    continue
+                if s.request_id is not None and s.request_id != request_id:
+                    continue
+                s.hits += 1
+                if s.times and s.fired >= s.times:
+                    continue
+                n = s.hits - s.after
+                if n <= 0 or (n - 1) % max(1, s.every):
+                    continue
+                if s.probability < 1.0 and self._rng.random() >= s.probability:
+                    continue
+                s.fired += 1
+                self._fired[point] = self._fired.get(point, 0) + 1
+                spec = s
+                break
+        if spec is None:
+            return
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter(
+                "fault_injected_total",
+                "injected faults fired, by point").inc(point=point,
+                                                       kind=spec.kind)
+        msg = spec.message or (
+            f"injected {spec.kind} fault at {point}"
+            f" (hit {spec.hits}, firing {spec.fired})")
+        if spec.kind == "latency":
+            time.sleep(spec.delay_s)
+            return
+        if spec.kind == "hang":
+            time.sleep(spec.delay_s)
+            raise TimeoutError(msg)
+        if spec.fatal:
+            raise FatalFaultError(msg, point)
+        raise FaultError(msg, point)
+
+    # ------------------------------------------------------------- introspect
+    def counts(self) -> dict:
+        """``{point: firings}`` so far (bench/CI assertions)."""
+        with self._lock:
+            return dict(self._fired)
+
+    def total_fired(self) -> int:
+        with self._lock:
+            return sum(self._fired.values())
+
+
+_INJECTOR = FaultInjector()
+
+
+def get_fault_injector() -> FaultInjector:
+    """The process-local injector shared by every seam."""
+    return _INJECTOR
+
+
+# Substrings in real accelerator/runtime error text that indicate a
+# retryable condition (XLA/PJRT status codes surface in the message).
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "TRANSFER",
+    "SOCKET CLOSED",
+    "CONNECTION RESET",
+    "TEMPORARILY",
+)
+
+
+def classify_transient(exc: BaseException) -> bool:
+    """Shared transient-vs-fatal taxonomy for the dispatch watchdog and the
+    replica breaker. Transient errors are retried with backoff; fatal ones
+    escalate (degradation / crash containment / quarantine)."""
+    if isinstance(exc, FaultError):
+        return exc.transient
+    if isinstance(exc, (TimeoutError, ConnectionError, BrokenPipeError)):
+        return True
+    if isinstance(exc, OSError):
+        return True
+    msg = str(exc).upper()
+    return any(marker in msg for marker in _TRANSIENT_MARKERS)

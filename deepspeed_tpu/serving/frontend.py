@@ -52,7 +52,12 @@ from deepspeed_tpu.serving.protocol import (
     encode_sse,
     sse_done,
 )
-from deepspeed_tpu.serving.router import Draining, Overloaded, ReplicaRouter
+from deepspeed_tpu.serving.router import (
+    DeadlineExceeded,
+    Draining,
+    Overloaded,
+    ReplicaRouter,
+)
 from deepspeed_tpu.telemetry import get_telemetry
 from deepspeed_tpu.telemetry.exporters import PrometheusExporter
 from deepspeed_tpu.telemetry.tracing import format_traceparent
@@ -144,7 +149,7 @@ def _make_handler(frontend: ServingFrontend):
             path, _, query = self.path.partition("?")
             if path == "/healthz":
                 state = router.state()
-                payload = {"status": state}
+                payload = {"status": state, "replicas": router.health()}
                 slo = get_telemetry().slo
                 if slo is not None:
                     payload["slo"] = slo.health()
@@ -211,6 +216,10 @@ def _make_handler(frontend: ServingFrontend):
             except Draining as e:
                 self._send_error_json(503, str(e))
                 return
+            except DeadlineExceeded as e:
+                self._send_error_json(504, str(e),
+                                      headers={"Retry-After": "1"})
+                return
             finally:
                 if ctx is not None and self._last_code:
                     # submit was rejected: close the root span here (the
@@ -232,13 +241,33 @@ def _make_handler(frontend: ServingFrontend):
                                   request_id=req.request_id,
                                   stream=req.stream)
 
+        # stream error_reasons that mean the replica (not the request) is at
+        # fault: the request is replayable token-identically elsewhere
+        _FAILOVER_REASONS = ("replica_died", "engine_crash")
+
         def _full_response(self, req, stream) -> None:
             try:
-                tokens, reason = stream.collect(
-                    timeout=frontend.request_timeout_s)
-            except StreamError as e:
-                self._send_error_json(400, str(e))
-                return
+                while True:
+                    try:
+                        tokens, reason = stream.collect(
+                            timeout=frontend.request_timeout_s)
+                        break
+                    except StreamError as e:
+                        if stream.error_reason in self._FAILOVER_REASONS:
+                            replay = router.resubmit(req)
+                            if replay is not None:
+                                stream = replay
+                                continue
+                        code = stream.error_code or 400
+                        detail = {}
+                        if stream.error_reason:
+                            detail["reason"] = stream.error_reason
+                        self._send_error_json(
+                            code, str(e),
+                            headers=({"Retry-After": "1"}
+                                     if code in (503, 504) else None),
+                            **detail)
+                        return
             except TimeoutError as e:
                 # the engine never finished inside the frontend's budget:
                 # that is a gateway timeout, not a client error. Abort the
@@ -276,29 +305,47 @@ def _make_handler(frontend: ServingFrontend):
                         if req.trace_ctx is not None else None)
             tokens: list[int] = []
             try:
-                for kind, value in stream.events(
-                        timeout=frontend.request_timeout_s):
-                    if kind == "token":
-                        frame = {"id": req.request_id, "token": value,
-                                 "index": len(tokens)}
-                        if trace_id:
-                            frame["trace_id"] = trace_id
-                        self.wfile.write(encode_sse(frame))
-                        self.wfile.flush()
-                        tokens.append(value)
-                    elif kind == "error":
-                        self.wfile.write(encode_sse(
-                            {"id": req.request_id, "error": value},
-                            event="error"))
+                while True:
+                    resubmitted = False
+                    # on failover the replacement stream replays from token
+                    # 0 (deterministic per-request seeds); skip the prefix
+                    # already on the wire and splice the tail seamlessly
+                    skip, seen = len(tokens), 0
+                    for kind, value in stream.events(
+                            timeout=frontend.request_timeout_s):
+                        if kind == "token":
+                            seen += 1
+                            if seen <= skip:
+                                continue
+                            frame = {"id": req.request_id, "token": value,
+                                     "index": len(tokens)}
+                            if trace_id:
+                                frame["trace_id"] = trace_id
+                            self.wfile.write(encode_sse(frame))
+                            self.wfile.flush()
+                            tokens.append(value)
+                        elif kind == "error":
+                            if (stream.error_reason
+                                    in self._FAILOVER_REASONS):
+                                replay = router.resubmit(req)
+                                if replay is not None:
+                                    stream = replay
+                                    resubmitted = True
+                                    break
+                            self.wfile.write(encode_sse(
+                                {"id": req.request_id, "error": value},
+                                event="error"))
+                            break
+                        else:  # done
+                            resp = CompletionResponse(
+                                request_id=req.request_id, tokens=tokens,
+                                finish_reason=value,
+                                prompt_tokens=len(req.prompt),
+                                trace_id=trace_id)
+                            self.wfile.write(encode_sse(resp.to_json()))
+                            self.wfile.write(sse_done())
+                    if not resubmitted:
                         break
-                    else:  # done
-                        resp = CompletionResponse(
-                            request_id=req.request_id, tokens=tokens,
-                            finish_reason=value,
-                            prompt_tokens=len(req.prompt),
-                            trace_id=trace_id)
-                        self.wfile.write(encode_sse(resp.to_json()))
-                        self.wfile.write(sse_done())
                 self.wfile.flush()
             except (BrokenPipeError, ConnectionError, TimeoutError, OSError):
                 # client went away (or stalled past the deadline): abort the

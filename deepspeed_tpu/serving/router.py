@@ -24,7 +24,7 @@ math is unit-testable without sockets or threads.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 from deepspeed_tpu.serving.engine_loop import (
     EngineLoop,
@@ -32,6 +32,7 @@ from deepspeed_tpu.serving.engine_loop import (
     ReplicaStats,
     TokenStream,
 )
+from deepspeed_tpu.serving.faults import POINT_SUBMIT, get_fault_injector
 from deepspeed_tpu.serving.protocol import CompletionRequest, ProtocolError
 from deepspeed_tpu.telemetry import get_telemetry
 
@@ -48,6 +49,10 @@ class Draining(RuntimeError):
     """The whole router is draining (maps to HTTP 503)."""
 
 
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired before placement (maps to HTTP 504)."""
+
+
 @dataclass(frozen=True)
 class RouterConfig:
     # per-replica bound on outstanding (queued + inflight) tokens before the
@@ -56,6 +61,43 @@ class RouterConfig:
     max_queue_tokens: int = 4096
     # Retry-After hint handed to rejected clients
     retry_after_s: float = 1.0
+    # --- circuit breaker (per replica, router→replica submit edge) ---
+    # consecutive submit failures that trip the breaker open (quarantine)
+    breaker_failures: int = 3
+    # quarantine dwell before one half-open probe is allowed through
+    breaker_reset_s: float = 5.0
+    # failover re-placements allowed per request after its replica dies
+    max_failovers: int = 1
+
+
+class _ReplicaHealth:
+    """Per-replica circuit breaker: closed → (failures) → open →
+    (``breaker_reset_s`` dwell) → half_open → one probe decides. A probe
+    failure while half-open re-opens immediately; a success closes."""
+
+    __slots__ = ("failures", "breaker", "opened_at")
+
+    def __init__(self):
+        self.failures = 0
+        self.breaker = "closed"
+        self.opened_at = 0.0
+
+    def note_success(self) -> None:
+        self.failures = 0
+        self.breaker = "closed"
+
+    def note_failure(self, now: float, threshold: int) -> None:
+        self.failures += 1
+        if self.breaker == "half_open" or self.failures >= threshold:
+            self.breaker = "open"
+            self.opened_at = now
+
+    def admissible(self, now: float, reset_s: float) -> bool:
+        if self.breaker == "closed":
+            return True
+        if self.breaker == "open" and now - self.opened_at >= reset_s:
+            self.breaker = "half_open"  # next submit is the probe
+        return self.breaker == "half_open"
 
 
 def plan_placement(
@@ -122,6 +164,9 @@ class ReplicaRouter:
         self.replicas = list(replicas)
         self.cfg = cfg or RouterConfig()
         self._placements: dict[str, EngineLoop] = {}
+        self._health = [_ReplicaHealth() for _ in self.replicas]
+        self._failovers: dict[str, int] = {}
+        self._faults = get_fault_injector()
         self._draining = False
 
     # ------------------------------------------------------------- submit
@@ -146,6 +191,19 @@ class ReplicaRouter:
         return self._submit_placed(req)[2]
 
     def _submit_placed(self, req: CompletionRequest):
+        tel = get_telemetry()
+        if (req.deadline_s is not None and req.t_submit
+                and time.perf_counter() - req.t_submit >= req.deadline_s):
+            # already-expired queue entry: shed before placement rather
+            # than dispatch doomed work that would hold KV blocks
+            if tel.enabled:
+                tel.counter(
+                    "serving_requests_shed_total",
+                    "expired-deadline requests shed pre-placement",
+                ).inc(replica="router")
+            raise DeadlineExceeded(
+                f"request {req.request_id}: deadline_s={req.deadline_s} "
+                "expired before placement")
         stats = [r.stats() for r in self.replicas]
         cap_tokens = max(s.max_request_tokens for s in stats)
         cap_blocks = max(s.max_request_blocks for s in stats)
@@ -154,33 +212,95 @@ class ReplicaRouter:
             raise ProtocolError(
                 f"prompt+max_tokens = {req.total_tokens} exceeds the "
                 f"serveable maximum ({cap_tokens} tokens)")
-        cached = [r.cached_prefix_tokens(req.prompt) for r in self.replicas]
-        idx, verdict = plan_placement(stats, req.total_tokens, self.cfg,
-                                      cached_tokens=cached)
-        tel = get_telemetry()
-        if idx is None:
-            if verdict == "draining":
-                raise Draining("server is draining")
+        excluded: set[int] = set()
+        while True:
+            now = time.perf_counter()
+            # mask replicas the breaker quarantines (or that already failed
+            # this submit) so plan_placement stays a pure function of stats
+            masked = [
+                s if (i not in excluded
+                      and self._health[i].admissible(
+                          now, self.cfg.breaker_reset_s))
+                else replace(s, alive=False)
+                for i, s in enumerate(stats)
+            ]
+            cached = [r.cached_prefix_tokens(req.prompt)
+                      for r in self.replicas]
+            idx, verdict = plan_placement(masked, req.total_tokens, self.cfg,
+                                          cached_tokens=cached)
+            if idx is None:
+                if verdict == "draining":
+                    # distinguish "every replica is gone/draining" (503)
+                    # from "live replicas exist but are quarantined or just
+                    # failed this submit" (429 + come back after the dwell)
+                    if any(s.alive and not s.draining for s in stats):
+                        raise Overloaded(
+                            "all live replicas quarantined by the circuit "
+                            "breaker", retry_after_s=self.cfg.breaker_reset_s)
+                    raise Draining("server is draining")
+                if tel.enabled:
+                    tel.counter("serving_requests_rejected_total").inc()
+                raise Overloaded(
+                    f"all {len(self.replicas)} replicas past "
+                    f"max_queue_tokens={self.cfg.max_queue_tokens}",
+                    retry_after_s=self.cfg.retry_after_s)
+            replica = self.replicas[idx]
+            try:
+                if self._faults.enabled:
+                    self._faults.fire(POINT_SUBMIT,
+                                      request_id=req.request_id)
+                stream = replica.submit(req)
+            except ReplicaDraining:
+                excluded.add(idx)
+                stats[idx] = replica.stats()
+                continue
+            except Exception as e:  # noqa: BLE001 - breaker feeds on these
+                self._health[idx].note_failure(time.perf_counter(),
+                                               self.cfg.breaker_failures)
+                if tel.enabled:
+                    tel.counter(
+                        "serving_submit_failures_total",
+                        "router→replica submit failures",
+                    ).inc(replica=replica.name, kind=type(e).__name__)
+                excluded.add(idx)
+                stats[idx] = replica.stats()
+                continue
+            self._health[idx].note_success()
+            self._placements[req.request_id] = replica
             if tel.enabled:
-                tel.counter("serving_requests_rejected_total").inc()
-            raise Overloaded(
-                f"all {len(self.replicas)} replicas past "
-                f"max_queue_tokens={self.cfg.max_queue_tokens}",
-                retry_after_s=self.cfg.retry_after_s)
-        replica = self.replicas[idx]
+                tel.counter("serving_requests_admitted_total").inc()
+                if verdict == "queue":
+                    tel.counter("serving_requests_queued_total").inc()
+            return idx, verdict, stream
+
+    def resubmit(self, req: CompletionRequest) -> TokenStream | None:
+        """Failover: re-place an in-flight request after its replica died or
+        its engine crashed. Deterministic per-request seeds make the replay
+        token-identical on any replica, so the frontend can splice the new
+        stream over the old one. Returns None when the per-request failover
+        budget is spent or the router is draining (caller surfaces the
+        original error)."""
+        if self._draining:
+            return None
+        n = self._failovers.get(req.request_id, 0)
+        if n >= self.cfg.max_failovers:
+            return None
+        self._failovers[req.request_id] = n + 1
+        self._placements.pop(req.request_id, None)
         try:
-            stream = replica.submit(req)
-        except ReplicaDraining:
-            raise Draining("server is draining") from None
-        self._placements[req.request_id] = replica
+            _, _, stream = self._submit_placed(req)
+        except Exception:  # noqa: BLE001 - no surviving placement
+            return None
+        tel = get_telemetry()
         if tel.enabled:
-            tel.counter("serving_requests_admitted_total").inc()
-            if verdict == "queue":
-                tel.counter("serving_requests_queued_total").inc()
-        return idx, verdict, stream
+            tel.counter(
+                "serving_failovers_total",
+                "in-flight requests re-placed on a surviving replica").inc()
+        return stream
 
     def cancel(self, request_id: str) -> None:
         replica = self._placements.pop(request_id, None)
+        self._failovers.pop(request_id, None)
         if replica is not None:
             replica.cancel(request_id)
             tel = get_telemetry()
@@ -191,17 +311,51 @@ class ReplicaRouter:
         """Forget a finished request's placement (frontend calls this after
         the terminal event so the map does not grow without bound)."""
         self._placements.pop(request_id, None)
+        self._failovers.pop(request_id, None)
 
     # -------------------------------------------------------------- state
     def state(self) -> str:
-        """Healthcheck verdict: ready | overloaded | draining."""
+        """Healthcheck verdict: ready | degraded | overloaded | draining.
+
+        "degraded" = still serving, but some replica is off its full device
+        path (engine ``degraded_mode`` > 0), quarantined by the breaker, or
+        dead while others carry the load."""
         if self._draining or not any(
                 r.stats().alive and not r.draining for r in self.replicas):
             return "draining"
         stats = [r.stats() for r in self.replicas]
         idx, verdict = plan_placement(stats, 1, self.cfg)
         del idx
-        return "overloaded" if verdict == "overloaded" else "ready"
+        if verdict == "overloaded":
+            return "overloaded"
+        if (any(s.degraded for s in stats)
+                or any(not s.alive for s in stats)
+                or any(h.breaker != "closed" for h in self._health)):
+            return "degraded"
+        return "ready"
+
+    def health(self) -> list[dict]:
+        """Per-replica health detail for /healthz: name, state
+        (healthy | degraded | quarantined | dead), breaker phase, engine
+        degradation rung, and containment counters."""
+        out = []
+        for r, h in zip(self.replicas, self._health):
+            s = r.stats()
+            if not s.alive:
+                state = "dead"
+            elif h.breaker == "open":
+                state = "quarantined"
+            elif s.degraded or h.breaker == "half_open":
+                state = "degraded"
+            else:
+                state = "healthy"
+            out.append({
+                "name": s.name, "state": state, "breaker": h.breaker,
+                "alive": s.alive, "draining": s.draining,
+                "degraded_mode": s.degraded, "crashes": s.crashes,
+                "respawns": s.respawns,
+            })
+        return out
 
     def begin_drain(self) -> None:
         """Stop admitting everywhere; non-blocking and signal-safe — the
@@ -239,3 +393,13 @@ class ReplicaRouter:
         tel.gauge("serving_kv_pending_blocks").set(
             sum(s.pending_blocks for s in stats))
         tel.gauge("serving_draining").set(1.0 if self._draining else 0.0)
+        breaker_rank = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+        for r, s, h in zip(self.replicas, stats, self._health):
+            tel.gauge(
+                "replica_breaker_state",
+                "0 closed | 1 half-open | 2 open (quarantined)",
+            ).set(breaker_rank[h.breaker], replica=r.name)
+            tel.gauge(
+                "replica_degraded_mode",
+                "engine degradation rung (0 full device path)",
+            ).set(float(s.degraded), replica=r.name)
