@@ -617,6 +617,111 @@ def run_decode_steady_subprocess(timeout: float = 900.0):
     return _run_flagged_subprocess("BENCH_DECODE_STEADY", timeout)
 
 
+def train_anatomy_main():
+    """Child process: training step anatomy (telemetry/stepscope.py).
+
+    Runs a short training loop with stepscope enabled — per-step phase
+    decomposition (data wait / H2D / forward / backward / grad collectives /
+    optimizer / recompile / checkpoint stall), MFU attribution, overlap
+    fraction and goodput — and emits the full breakdown as one JSON line so
+    BENCH_r0x records track overlap/goodput alongside MFU (ROADMAP item #4's
+    measurement harness). Also exports the step→phase trace and reports span
+    counts plus the scrape-visibility of the headline gauges, which the CI
+    smoke step asserts on.
+    """
+    import tempfile
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.telemetry import TELEMETRY
+
+    e = os.environ
+    model_cfg = llama.LlamaConfig(
+        vocab_size=int(e.get("BENCH_ANATOMY_VOCAB", 512)),
+        hidden_size=int(e.get("BENCH_ANATOMY_HIDDEN", 128)),
+        intermediate_size=int(e.get("BENCH_ANATOMY_FFN", 256)),
+        num_layers=int(e.get("BENCH_ANATOMY_LAYERS", 2)),
+        num_heads=int(e.get("BENCH_ANATOMY_HEADS", 4)),
+        num_kv_heads=int(e.get("BENCH_ANATOMY_KV", 2)),
+        max_seq_len=int(e.get("BENCH_ANATOMY_SEQ", 128)),
+    )
+    seq = int(e.get("BENCH_ANATOMY_SEQ", 128))
+    batch = int(e.get("BENCH_ANATOMY_BATCH", 8))
+    steps = int(e.get("BENCH_ANATOMY_STEPS", 8))
+    gas = int(e.get("BENCH_ANATOMY_GAS", 2))
+
+    runs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "runs")
+    os.makedirs(runs_dir, exist_ok=True)
+    config = {
+        "train_batch_size": batch,
+        "gradient_accumulation_steps": gas,
+        "sequence_length": seq,
+        "steps_per_print": 0,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": -1},
+        "telemetry": {
+            "enabled": True,
+            "jsonl_path": os.path.join(runs_dir,
+                                       "BENCH_train_anatomy_telemetry.jsonl"),
+            "stepscope": {"enabled": True},
+        },
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(model_cfg, ctx=ctx), config=config)
+
+    rng = np.random.default_rng(0)
+
+    def data_iter():
+        while True:
+            yield {"input_ids": rng.integers(
+                0, model_cfg.vocab_size,
+                (batch // gas, seq), dtype=np.int32)}
+
+    it = data_iter()
+    for _ in range(steps):
+        engine.train_batch(data_iter=it)
+    # one checkpoint save so the goodput ledger has a checkpoint entry
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        engine.save_checkpoint(ckpt_dir)
+    summary = engine.stepscope.summary()
+
+    trace_path = os.path.join(runs_dir, "BENCH_train_anatomy_trace.json")
+    trace = TELEMETRY.dump_trace(trace_path)
+    events = trace.get("traceEvents", [])
+    step_spans = [ev for ev in events if ev.get("name") == "train/step"]
+    step_ids = {ev.get("args", {}).get("span_id") for ev in step_spans}
+    phase_spans = [ev for ev in events
+                   if str(ev.get("name", "")).startswith("train/phase/")]
+    nested = [ev for ev in phase_spans
+              if ev.get("args", {}).get("parent_id") in step_ids]
+    prom = TELEMETRY.registry.render_prometheus()
+
+    engine.destroy()
+    print(json.dumps({
+        "error": None,
+        "anatomy": summary,
+        "steps": steps,
+        "train_batch_size": batch,
+        "gas": gas,
+        "trace_path": trace_path,
+        "trace_step_spans": len(step_spans),
+        "trace_phase_spans": len(phase_spans),
+        "trace_nested_phase_spans": len(nested),
+        "scrape_has_overlap": "train_overlap_fraction" in prom,
+        "scrape_has_goodput": "train_goodput" in prom,
+        "scrape_has_phase_histogram": "step_phase_seconds" in prom,
+        "scrape_has_flops_source": "train_flops_source" in prom,
+    }))
+    return 0
+
+
+def run_train_anatomy_subprocess(timeout: float = 900.0):
+    return _run_flagged_subprocess("BENCH_TRAIN_ANATOMY", timeout)
+
+
 def infinity_trial_main():
     """Child process: ZeRO-Infinity offload rung — train a model whose fp32
     training state EXCEEDS the chip's HBM (params + Adam moments + grads),
@@ -1488,9 +1593,19 @@ def main():
                 return 1
             print(json.dumps(result))
             return 0 if result.get("chaos_ok") else 1
+        if mode == ["train-anatomy"]:
+            result, err = run_train_anatomy_subprocess()
+            if result is None:
+                print(f"train-anatomy bench failed:\n{_err_text(err)}",
+                      file=sys.stderr)
+                _fail_json(err)
+                return 1
+            print(json.dumps(result))
+            return 0
         if mode != ["serving"]:
             print(f"bench: unknown --mode {mode or '(missing)'}; "
-                  "supported: serving, decode-steady, chaos", file=sys.stderr)
+                  "supported: serving, decode-steady, chaos, train-anatomy",
+                  file=sys.stderr)
             return 2
         if "--shared-prefix-tokens" in sys.argv:
             # shared-prompt workload: prompts share an N-token prefix and
@@ -1524,6 +1639,10 @@ def main():
     if os.environ.get("BENCH_DECODE_STEADY"):
         _enable_jit_cache()
         return decode_steady_main()
+    if os.environ.get("BENCH_TRAIN_ANATOMY"):
+        # no shared jit cache: recompile accounting is part of what this
+        # trial measures, so cold compiles must be real
+        return train_anatomy_main()
     if os.environ.get("BENCH_LEARN"):
         _enable_jit_cache()
         return learn_trial_main()

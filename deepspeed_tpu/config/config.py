@@ -489,6 +489,11 @@ class TelemetryConfig(ConfigBase):
     monitor_sink: bool = False
     # flush the file sink every N emitted records
     flush_interval_events: int = 100
+    # {enabled, interconnect_gbps, peak_tflops, use_cost_analysis}: training
+    # step anatomy (telemetry/stepscope.py) — per-phase decomposition spans,
+    # MFU attribution, overlap + goodput gauges. Enabling it settles every
+    # step (microscope mode) and implies the trace ring on.
+    stepscope: dict = field(default_factory=dict)
 
     def _validate(self, path: str = "") -> None:
         if self.flush_interval_events < 1:

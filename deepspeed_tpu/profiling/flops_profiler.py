@@ -11,6 +11,7 @@ programs, unlike hook-based counting which misses fused ops.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 import jax
@@ -91,10 +92,25 @@ def _decoder_breakdown(cfg, batch: int, seq: int) -> dict:
     return {"qkv+out": qkvo, "attention": attn, "mlp": mlp, "lm_head": vocab}
 
 
+# get_model_profile memo: the result is pure in (model_spec, shape), and the
+# engine's analytic-flops fallback is scraped per tflops() read — recomputing
+# the breakdown (and worse, a with_compiled lowering) per scrape is waste.
+# The stored model_spec reference pins the id() key against reuse-after-gc.
+_PROFILE_CACHE: dict = {}
+_PROFILE_CACHE_LOCK = threading.Lock()
+
+
 def get_model_profile(model_spec, batch: int, seq: int, with_compiled: bool = True,
                       ) -> ProfileResult:
-    """Reference ``get_model_profile`` analog for a ModelSpec."""
+    """Reference ``get_model_profile`` analog for a ModelSpec. Memoized on
+    (model_spec identity, batch, seq, with_compiled)."""
     import jax.numpy as jnp
+
+    key = (id(model_spec), int(batch), int(seq), bool(with_compiled))
+    with _PROFILE_CACHE_LOCK:
+        hit = _PROFILE_CACHE.get(key)
+        if hit is not None and hit[0] is model_spec:
+            return hit[1]
 
     breakdown = {}
     try:
@@ -113,13 +129,16 @@ def get_model_profile(model_spec, batch: int, seq: int, with_compiled: bool = Tr
             compiled = program_cost(model_spec.forward_fn, params, ids)
         except Exception as e:  # backend without cost model
             compiled = {"error": str(e)[:100]}
-    return ProfileResult(
+    result = ProfileResult(
         params=model_spec.num_params,
         flops_fwd=flops_fwd,
         macs_fwd=flops_fwd / 2.0,
         compiled=compiled,
         breakdown=breakdown,
     )
+    with _PROFILE_CACHE_LOCK:
+        _PROFILE_CACHE[key] = (model_spec, result)
+    return result
 
 
 class FlopsProfiler:

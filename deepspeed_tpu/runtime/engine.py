@@ -393,6 +393,8 @@ class Engine:
             batch_size=config.train_batch_size or 1,
             steps_per_output=config.steps_per_print,
         )
+        self._flops_source = "analytic"
+        self._model_profile = None  # cached get_model_profile result
         if self.model_spec.flops_per_token and config.sequence_length:
             self.tput_timer.flops_per_sample = (
                 self.model_spec.flops_per_token(config.sequence_length)
@@ -401,15 +403,18 @@ class Engine:
         elif config.sequence_length:
             # the model exposes no flops_per_token: fall back to the flops
             # profiler's analytic per-layer count so tflops() reports a real
-            # number instead of 0.0 (fwd x3 ~ fwd+bwd training flops)
+            # number instead of 0.0 (fwd x3 ~ fwd+bwd training flops).
+            # get_model_profile memoizes, so this is computed once per
+            # (model, shape) rather than per tflops() scrape.
             try:
                 from deepspeed_tpu.profiling.flops_profiler import get_model_profile
 
-                prof = get_model_profile(
+                self._model_profile = get_model_profile(
                     self.model_spec, batch=1, seq=config.sequence_length,
                     with_compiled=False)
-                if prof.flops_fwd:
-                    self.tput_timer.flops_per_sample = 3.0 * prof.flops_fwd
+                if self._model_profile.flops_fwd:
+                    self.tput_timer.flops_per_sample = (
+                        3.0 * self._model_profile.flops_fwd)
             except Exception as e:
                 log_dist(f"analytic flops estimate unavailable: {e}", ranks=[0])
 
@@ -436,6 +441,52 @@ class Engine:
                     "Train/flops_per_sample",
                     float(self.tput_timer.flops_per_sample), 0)])
         self._prev_step_wall = 0.0  # host wall clock of the previous _after_step
+        self._step_miss0 = None  # compile-miss count at the current step's start
+
+        # training step anatomy (telemetry/stepscope.py): per-phase spans +
+        # MFU attribution + overlap/goodput gauges. Off by default; enabling
+        # settles each step (microscope mode, docs/OBSERVABILITY.md).
+        ss_opts = dict(config.telemetry.stepscope or {})
+        ss_enabled = bool(ss_opts.get("enabled"))
+        if (ss_enabled and ss_opts.get("use_cost_analysis", True)
+                and config.sequence_length):
+            # refine the analytic estimate with XLA's cost model for the
+            # compiled forward — exact for the lowered program
+            try:
+                from deepspeed_tpu.profiling.flops_profiler import get_model_profile
+
+                self._model_profile = get_model_profile(
+                    self.model_spec, batch=1, seq=config.sequence_length,
+                    with_compiled=True)
+                cflops = float((self._model_profile.compiled or {}).get(
+                    "flops", 0.0) or 0.0)
+                if cflops > 0.0:
+                    self.tput_timer.flops_per_sample = 3.0 * cflops
+                    self._flops_source = "cost_analysis"
+            except Exception as e:
+                log_dist(f"cost-analysis flops unavailable ({e}); "
+                         "keeping analytic estimate", ranks=[0])
+        if self.telemetry.enabled:
+            self.telemetry.gauge(
+                "train_flops_source",
+                "1 for the flops estimate feeding train_tflops/MFU "
+                "(analytic|cost_analysis)").set(1.0, source=self._flops_source)
+        from deepspeed_tpu.telemetry.stepscope import StepScope
+
+        self.stepscope = StepScope(
+            self.telemetry,
+            enabled=ss_enabled,
+            batch_size=config.train_batch_size or 1,
+            fwd_flops_per_step=(self.tput_timer.flops_per_sample / 3.0)
+            * (config.train_batch_size or 1),
+            param_count=int(self.model_spec.num_params or 0),
+            collective_bytes_per_step=self._grad_wire_bytes(),
+            peak_tflops=ss_opts.get("peak_tflops"),
+            interconnect_gbps=float(ss_opts.get("interconnect_gbps", 100.0)),
+            straggler_warn_ratio=float(
+                config.comms_logger.straggler_warn_ratio),
+            flops_source=self._flops_source,
+        )
 
         if (config.progressive_layer_drop.enabled
                 and not self.model_spec.supports_pld):
@@ -912,6 +963,41 @@ class Engine:
             COMMS_LOGGER.append_traced("all_reduce", grad_bytes, "data",
                                        dp, caller="train_batch_fn")
 
+    def _grad_wire_bytes(self) -> float:
+        """Estimated per-step gradient-sync wire bytes (same plan as
+        ``_record_comms_plan``, with ring-collective wire factors): feeds the
+        stepscope overlap estimate."""
+        dp, fs = self.topo.size("data"), self.topo.size("fsdp")
+        if dp <= 1 and fs <= 1:
+            return 0.0
+        grad_bytes = 4 * sum(
+            int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+        wire = 0.0
+        if fs > 1:
+            # ring reduce-scatter + all-gather each move (n-1)/n of the data
+            wire += 2.0 * grad_bytes * (fs - 1) / fs
+        if dp > 1:
+            # ring all-reduce = reduce-scatter + all-gather
+            wire += 2.0 * grad_bytes * (dp - 1) / dp
+        return wire
+
+    def _jit_miss_count(self) -> float:
+        """Cumulative backend-compile count from the PR 5 monitoring listener
+        (used to tag recompile-bearing steps)."""
+        if not self.telemetry.enabled:
+            return 0.0
+        return self.telemetry.registry.counter(
+            "jit_cache_misses_total",
+            "XLA compilations observed").value(source="monitoring")
+
+    def _step_recompiled(self) -> bool:
+        """True when the in-progress step triggered an XLA compilation —
+        those steps are excluded from the throughput average (their wall time
+        is compile stall, not steady-state step time)."""
+        if self._step_miss0 is None:
+            return False
+        return self._jit_miss_count() > self._step_miss0
+
     def _build_train_batch_fn_qgrad(self):
         """Fused step with qgZ gradient reduction (reference ZeRO++
         ``all_to_all_quant_reduce``, ``coalesced_collectives.py:31``): the GAS
@@ -1122,14 +1208,20 @@ class Engine:
         per-group program (speculative dispatch, no host sync)."""
         if self._grads_jit is None:
             self._grads_jit = self._build_grads_fn()
+        scope = self.stepscope if self.stepscope.enabled else None
         dev_batch = self._put_gas_batch(batch)
         self.tput_timer.start()
+        _c0 = time.perf_counter() if scope is not None else 0.0
         loss, grad_sum = self._grads_jit(
             self.params, self.scale_state, jnp.int32(self.global_steps),
             self._train_rng, dev_batch,
         )
         gnorm, finite_dev, factor, lr = self._get_pre_jit()(
             grad_sum, self.scale_state.scale, jnp.int32(self.global_steps))
+        if scope is not None:
+            jax.block_until_ready((loss, gnorm))
+            scope.note_phase("compute", _c0, time.perf_counter())
+            _o0 = time.perf_counter()
         p_leaves = jax.tree_util.tree_leaves(self.params)
         g_leaves = jax.tree_util.tree_leaves(grad_sum)
         new_p_leaves = list(p_leaves)
@@ -1145,6 +1237,10 @@ class Engine:
         self.params = jax.tree_util.tree_unflatten(
             self._param_treedef, new_p_leaves)
         self.opt_state = new_opt
+        if scope is not None:
+            # the per-group walk is host-measured (no attribution needed)
+            jax.block_until_ready(new_p_leaves)
+            scope.note_phase("optimizer", _o0, time.perf_counter())
         step_scale = self.scale_state.scale
         self.scale_state = precision.update_loss_scale(
             self.scale_state, finite_dev, self.config.fp16)
@@ -1159,7 +1255,7 @@ class Engine:
         self._inflight.append(metrics["loss"])
         if len(self._inflight) > self._max_inflight:
             jax.block_until_ready(self._inflight.pop(0))
-        self.tput_timer.stop(global_step=True)
+        self.tput_timer.stop(global_step=True, exclude=self._step_recompiled())
         self._after_step(metrics)
         self.micro_steps += self.gas
         return metrics["loss"]
@@ -1171,8 +1267,10 @@ class Engine:
         the step end)."""
         if self._grads_jit is None:
             self._grads_jit = self._build_grads_fn()
+        scope = self.stepscope if self.stepscope.enabled else None
         dev_batch = self._put_gas_batch(batch)
         self.tput_timer.start()
+        _c0 = time.perf_counter() if scope is not None else 0.0
         # issue the group-0 NVMe read NOW: it overlaps the whole fwd/bwd
         # (harmless if the step overflows — the read stays valid for the
         # next step since skipped steps write nothing)
@@ -1184,6 +1282,10 @@ class Engine:
         cfg = self.config
         gnorm, finite_dev, factor, lr = self._get_pre_jit()(
             grad_sum, self.scale_state.scale, jnp.int32(self.global_steps))
+        if scope is not None:
+            jax.block_until_ready((loss, gnorm))
+            scope.note_phase("compute", _c0, time.perf_counter())
+            _o0 = time.perf_counter()
         speculative = cfg.zero_optimization.offload_optimizer.super_offload
         if speculative:
             # SuperOffload speculative step (reference
@@ -1223,6 +1325,10 @@ class Engine:
             self.params = jax.tree_util.tree_unflatten(
                 self._param_treedef, new_p_leaves)
             self._swapper.commit()
+        if scope is not None:
+            # NVMe-walk time (swap-in/apply/swap-out) is host-measured
+            jax.block_until_ready(jax.tree_util.tree_leaves(self.params))
+            scope.note_phase("optimizer", _o0, time.perf_counter())
         step_scale = self.scale_state.scale  # the scale THIS step ran at
         self.scale_state = precision.update_loss_scale(
             self.scale_state, finite_dev, cfg.fp16)
@@ -1233,7 +1339,7 @@ class Engine:
             "loss_scale": step_scale,
             "skipped": jnp.logical_not(finite_dev),
         }
-        self.tput_timer.stop(global_step=True)
+        self.tput_timer.stop(global_step=True, exclude=self._step_recompiled())
         self._after_step(metrics)
         self.micro_steps += self.gas
         return metrics["loss"]
@@ -1339,12 +1445,18 @@ class Engine:
         zf = self.config.zero_optimization.zenflow
         if self._grads_jit is None:
             self._grads_jit = self._build_grads_fn()
+        scope = self.stepscope if self.stepscope.enabled else None
         dev_batch = self._put_gas_batch(batch)
         self.tput_timer.start()
+        _c0 = time.perf_counter() if scope is not None else 0.0
         loss, grad_sum = self._grads_jit(
             self.params, self.scale_state, jnp.int32(self.global_steps),
             self._train_rng, dev_batch,
         )
+        if scope is not None:
+            jax.block_until_ready(loss)
+            scope.note_phase("compute", _c0, time.perf_counter())
+            _o0 = time.perf_counter()
         g_leaves, _ = jax.tree_util.tree_flatten(grad_sum)
         p_leaves, tdef = jax.tree_util.tree_flatten(self.params)
         step = self.global_steps
@@ -1395,11 +1507,16 @@ class Engine:
             if self._zf_n_acc >= zf.update_interval:
                 self._zf_cold_boundary(tdef)
         metrics["loss"] = loss
+        if scope is not None:
+            # hot/cold update tail (selection + hot apply + cold flush) is
+            # host-measured
+            jax.block_until_ready(jax.tree_util.tree_leaves(self.params))
+            scope.note_phase("optimizer", _o0, time.perf_counter())
         # same bounded async-dispatch window as the fused path
         self._inflight.append(metrics["loss"])
         if len(self._inflight) > self._max_inflight:
             jax.block_until_ready(self._inflight.pop(0))
-        self.tput_timer.stop(global_step=True)
+        self.tput_timer.stop(global_step=True, exclude=self._step_recompiled())
         self._after_step(metrics)
         self.micro_steps += self.gas
         return metrics["loss"]
@@ -1440,6 +1557,8 @@ class Engine:
 
     def _put_gas_batch(self, batch: dict):
         """[B_global, ...] -> [GAS, micro*dp, ...] placed on the mesh."""
+        scope = self.stepscope if self.stepscope.enabled else None
+        t0 = time.perf_counter() if scope is not None else 0.0
         out = {}
         gas = self.gas
         for k, v in batch.items():
@@ -1450,6 +1569,11 @@ class Engine:
                 )
             v = v.reshape((gas, v.shape[0] // gas) + v.shape[1:])
             out[k] = jax.device_put(v, self._batch_sharding(v.ndim, True))
+        if scope is not None:
+            # settle the transfers so the h2d phase wall is real (microscope
+            # mode: anatomy over async-dispatch overlap)
+            jax.block_until_ready(out)
+            scope.note_phase("h2d", t0, time.perf_counter())
         return out
 
     def _next_rng(self):
@@ -1460,15 +1584,23 @@ class Engine:
     def train_batch(self, batch: dict | None = None, data_iter: Iterator | None = None):
         """Fused full step: GAS microbatches + optimizer update in one XLA program
         (reference ``PipelineEngine.train_batch:337`` / engine fwd+bwd+step loop)."""
+        scope = self.stepscope if self.stepscope.enabled else None
+        if scope is not None:
+            scope.begin_step(self.global_steps)
         if batch is None:
             if data_iter is None:
                 if self.training_dataloader is None:
                     raise ValueError("train_batch needs a batch, data_iter, or training_data")
                 data_iter = self.training_dataloader
+            _dw0 = time.perf_counter() if scope is not None else 0.0
             micro = [next(data_iter) for _ in range(self.gas)]
             batch = {k: np.concatenate([np.asarray(m[k]) for m in micro]) for k in micro[0]}
+            if scope is not None:
+                scope.note_phase("data_wait", _dw0, time.perf_counter())
         if self.config.debug.sanity_checks:
             self._sanity_check_batch(batch)
+        self._step_miss0 = (self._jit_miss_count()
+                            if self.telemetry.enabled else None)
         self.step_tracer.before_step(self.global_steps)
         if self._offload_mode == "nvme":
             return self._train_batch_nvme(batch)
@@ -1498,6 +1630,7 @@ class Engine:
             self._train_batch_jit = fn
         dev_batch = self._put_gas_batch(batch)
         self.tput_timer.start()
+        _c0 = time.perf_counter() if scope is not None else 0.0
         # 1-bit-family two-phase wire: dense program during the optimizer's
         # variance warmup, compressed program after (reference onebit/adam.py
         # all_reduce -> compressed_allreduce handoff at freeze_step)
@@ -1535,10 +1668,16 @@ class Engine:
         # A bounded in-flight window (block on the step from _max_inflight ago)
         # keeps the host from running unboundedly ahead; per-step wall times are
         # only accurate at settle points (steps_per_print / window boundary).
+        if scope is not None:
+            # microscope mode (stepscope): settle the fused program so the
+            # device window is a real host wall — anatomy trades away the
+            # async pipeline's overlap, by design
+            jax.block_until_ready(metrics["loss"])
+            scope.note_phase("compute", _c0, time.perf_counter())
         self._inflight.append(metrics["loss"])
         if len(self._inflight) > self._max_inflight:
             jax.block_until_ready(self._inflight.pop(0))
-        self.tput_timer.stop(global_step=True)
+        self.tput_timer.stop(global_step=True, exclude=self._step_recompiled())
         self._after_step(metrics)
         self.micro_steps += self.gas
         return metrics["loss"]
@@ -1576,9 +1715,14 @@ class Engine:
         if self.config.debug.sanity_checks:
             micro_total = (self.config.train_batch_size or 0) // self.gas or None
             self._sanity_check_batch(batch, expected=micro_total)
+        scope = self.stepscope if self.stepscope.enabled else None
         if self._acc_grads is None:
             # a fresh accumulation cycle = a new "step" for the tracer
             self.step_tracer.before_step(self.global_steps)
+            self._step_miss0 = (self._jit_miss_count()
+                                if self.telemetry.enabled else None)
+            if scope is not None:
+                scope.begin_step(self.global_steps)
         if self._accum_jit is None:
             self._accum_jit = self._build_accum_fn()
         if self._acc_grads is None:
@@ -1596,6 +1740,9 @@ class Engine:
             self._next_rng(),
             self._put_microbatch(batch),
         )
+        if scope is not None:
+            jax.block_until_ready(loss)
+            scope.note_phase("compute", t0, time.perf_counter())
         if t0:
             # host-visible fwd+bwd dispatch time (the reference's fwd/bwd
             # timers are the same host wall clock under async dispatch)
@@ -1618,6 +1765,7 @@ class Engine:
             return
         if self._apply_jit is None:
             self._apply_jit = self._build_apply_fn()
+        scope = self.stepscope if self.stepscope.enabled else None
         t0 = time.perf_counter() if self.telemetry.enabled else 0.0
         self.params, self.opt_state, self.scale_state, metrics = self._apply_jit(
             self.params,
@@ -1627,6 +1775,9 @@ class Engine:
             jnp.float32(self._acc_count),
             jnp.int32(self.global_steps),
         )
+        if scope is not None:
+            jax.block_until_ready(metrics)
+            scope.note_phase("optimizer", t0, time.perf_counter())
         if t0:
             self.telemetry.emit_span("train/opt_step",
                                      time.perf_counter() - t0,
@@ -1681,6 +1832,10 @@ class Engine:
             raise ValueError("sanity: input_ids must be an integer array")
 
     def _after_step(self, metrics):
+        if self.stepscope.enabled:
+            # close the anatomy window (all paths funnel here); the recompile
+            # share comes from the compile-listener delta since begin_step
+            self.stepscope.end_step(self.global_steps)
         self.global_steps += 1
         self.global_samples += int(self.config.train_batch_size or 0)
         # accumulate skips on-device (async); synced lazily by .skipped_steps
@@ -1727,6 +1882,10 @@ class Engine:
                 f"grad_norm={float(self._last_metrics['grad_norm']):.3f} {skip_str}",
                 ranks=[0],
             )
+            if self.stepscope.enabled:
+                # symmetric settle point on every host: safe spot for the
+                # straggler-skew allgather
+                self.stepscope.refresh_skew()
         self.step_tracer.after_step(self.global_steps - 1)
 
     def _emit_step_telemetry(self, vals: dict) -> None:
@@ -1884,6 +2043,8 @@ class Engine:
                 "wall clock of the last checkpoint save").set(dur)
             self.telemetry.counter(
                 "checkpoint_saves_total", "checkpoints written").inc()
+            if self.stepscope.enabled:
+                self.stepscope.note_overhead("checkpoint", dur)
         return ckpt_dir
 
     def _join_ckpt_writer(self):
@@ -2004,6 +2165,8 @@ class Engine:
             self.telemetry.gauge(
                 "checkpoint_last_load_seconds",
                 "wall clock of the last checkpoint load").set(dur)
+            if self.stepscope.enabled:
+                self.stepscope.note_overhead("checkpoint", dur)
         return ckpt_dir, manifest.get("client_state", {})
 
     # ------------------------------------------------------------------ accessors

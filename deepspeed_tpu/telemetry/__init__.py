@@ -28,6 +28,7 @@ from deepspeed_tpu.telemetry.registry import (  # noqa: F401
     Histogram,
     MetricsRegistry,
 )
+from deepspeed_tpu.telemetry.stepscope import StepScope  # noqa: F401
 from deepspeed_tpu.telemetry.slo import (  # noqa: F401
     SloMonitor,
     SloObjective,

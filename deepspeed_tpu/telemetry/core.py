@@ -95,6 +95,13 @@ class Telemetry:
             tracing = opts.get("tracing") or {}
             if tracing is True:
                 tracing = {"enabled": True}
+            stepscope = opts.get("stepscope") or {}
+            if stepscope is True:
+                stepscope = {"enabled": True}
+            if stepscope.get("enabled") and not tracing.get("enabled"):
+                # step-anatomy spans land in the trace ring; an enabled
+                # stepscope without explicit tracing opts implies tracing on
+                tracing = {"enabled": True}
             if tracing.get("enabled"):
                 self.tracer.configure(
                     enabled=True,

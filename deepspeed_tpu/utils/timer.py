@@ -102,16 +102,33 @@ class ThroughputTimer:
     logging_fn: object = None
     total_elapsed: float = field(default=0.0, init=False)
     step_count: int = field(default=0, init=False)
+    # steps stopped with exclude=True (compile-bearing): counted separately
+    # so compile stalls don't drag the steady-state throughput average
+    excluded_elapsed: float = field(default=0.0, init=False)
+    excluded_count: int = field(default=0, init=False)
     _start: float = field(default=0.0, init=False)
+    _started: bool = field(default=False, init=False)
     flops_per_sample: float = field(default=0.0, init=False)
     last_duration: float = field(default=0.0, init=False)  # most recent start->stop
 
     def start(self) -> None:
         self._start = time.perf_counter()
+        self._started = True
 
-    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+    def stop(self, global_step: bool = True, report_speed: bool = True,
+             exclude: bool = False) -> None:
+        if not self._started:
+            # stop() before any start(): _start would be the process epoch
+            # and the "duration" garbage — drop the sample
+            return
+        self._started = False
         duration = time.perf_counter() - self._start
         self.last_duration = duration
+        if exclude:
+            if global_step:
+                self.excluded_elapsed += duration
+                self.excluded_count += 1
+            return
         self.total_elapsed += duration
         if global_step:
             self.step_count += 1
@@ -123,11 +140,11 @@ class ThroughputTimer:
                 )
 
     def throughput(self) -> float:
-        if self.total_elapsed == 0:
+        if self.step_count <= 0 or self.total_elapsed <= 0:
             return 0.0
         return self.batch_size * self.step_count / self.total_elapsed
 
     def tflops(self) -> float:
-        if self.total_elapsed == 0 or self.flops_per_sample == 0:
+        if self.flops_per_sample <= 0:
             return 0.0
         return self.flops_per_sample * self.throughput() / 1e12

@@ -73,10 +73,21 @@ class StepTracer:
     def before_step(self, step: int) -> None:
         if not self.cfg.enabled or self._done:
             return
+        # a step that raised mid-window never reached after_step: exit the
+        # stale annotation before opening a new one
+        self._exit_step_ann()
         # >= so a resumed run (global step already past start_step) still
         # captures its first window
         if not self._active and step >= self.cfg.start_step:
-            jax.profiler.start_trace(self.cfg.trace_dir)
+            try:
+                jax.profiler.start_trace(self.cfg.trace_dir)
+            except Exception as e:
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning(f"StepTracer: start_trace failed ({e}); "
+                               "capture disabled for this run")
+                self._finish()
+                return
             self._active = True
             self._started_at = step
         if self._active:
@@ -85,15 +96,43 @@ class StepTracer:
             self._step_ann.__enter__()
 
     def after_step(self, step: int) -> None:
-        if self._step_ann is not None:
-            self._step_ann.__exit__(None, None, None)
-            self._step_ann = None
+        self._exit_step_ann()
         if self._active and step >= self._started_at + self.cfg.num_steps - 1:
+            self.stop_trace()
+            self._finish()
+
+    def stop_trace(self) -> None:
+        """End the capture window if one is open. Idempotent and
+        exception-safe: a failed step inside the window must not leave an
+        unmatched ``jax.profiler.start_trace`` wedging the next capture."""
+        self._exit_step_ann()
+        if not self._active:
+            return
+        # flip first: even if the sync or the profiler raises, we never
+        # attempt a second stop on the same window
+        self._active = False
+        try:
             if self.sync_fn is not None:
                 self.sync_fn()
+        except Exception:
+            # device work from the failed step may be poisoned; still try to
+            # finalize the capture file
+            pass
+        try:
             jax.profiler.stop_trace()
-            self._active = False
-            self._finish()
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(f"StepTracer: stop_trace failed ({e}); "
+                           "capture for this window is lost")
+
+    def _exit_step_ann(self) -> None:
+        if self._step_ann is not None:
+            ann, self._step_ann = self._step_ann, None
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
 
     def _finish(self) -> None:
         """Capture complete: drop the engine-capturing sync closure and the
@@ -109,12 +148,5 @@ class StepTracer:
             pass
 
     def close(self) -> None:
-        if self._step_ann is not None:
-            self._step_ann.__exit__(None, None, None)
-            self._step_ann = None
-        if self._active:
-            if self.sync_fn is not None:
-                self.sync_fn()
-            jax.profiler.stop_trace()
-            self._active = False
+        self.stop_trace()
         self._finish()

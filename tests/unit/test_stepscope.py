@@ -1,0 +1,174 @@
+"""Training step anatomy (telemetry/stepscope.py + engine wiring).
+
+Pins the PR acceptance criteria: nested step→phase trace spans with and
+without grad accumulation, phase sum within 5% of the measured step wall
+clock, overlap/goodput/MFU gauges on the scrape, recompile exclusion from the
+throughput average, checkpoint stall accounting, and a zero-allocation hot
+path when stepscope is disabled (tracemalloc-pinned, same discipline as the
+PR 5 serving tracer)."""
+
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.topology import reset_topology
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.telemetry import TELEMETRY
+
+
+def _engine(extra=None, gas=1, stepscope=True):
+    reset_topology()
+    telemetry = {"enabled": True}
+    if stepscope:
+        telemetry["stepscope"] = {"enabled": True}
+    cfg = {
+        "train_micro_batch_size_per_device": 2,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 0,
+        "sequence_length": 16,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": 8},
+        "telemetry": telemetry,
+        **(extra or {}),
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(llama.LlamaConfig.tiny(256), ctx=ctx),
+        config=cfg)
+    return engine
+
+
+def _batch(n=16, seq=16):
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, 256, (n, seq), dtype=np.int32)}
+
+
+@pytest.mark.parametrize("gas", [1, 2])
+def test_nested_step_phase_spans(gas):
+    engine = _engine(gas=gas)
+    batch = _batch(16 * gas)
+    for _ in range(3):
+        engine.train_batch(batch)
+    events = TELEMETRY.dump_trace()["traceEvents"]
+    steps = [e for e in events if e["name"] == "train/step"]
+    assert len(steps) == 3
+    step_ids = {e["args"]["span_id"] for e in steps}
+    phases = [e for e in events if e["name"].startswith("train/phase/")]
+    assert phases, "no phase children recorded"
+    # every phase span is the child of some step span (Perfetto nesting)
+    assert all(e["args"].get("parent_id") in step_ids for e in phases)
+    names = {e["name"].removeprefix("train/phase/") for e in phases}
+    # h2d + the attributed compute split always present; recompile on the
+    # compile-bearing steps
+    assert {"h2d", "forward", "backward"} <= names
+    assert "recompile" in names
+    # children tile inside their parent's [ts, ts+dur] window
+    by_id = {e["args"]["span_id"]: e for e in steps}
+    for ph in phases:
+        parent = by_id[ph["args"]["parent_id"]]
+        assert ph["ts"] >= parent["ts"] - 1.0  # 1 us slack on float math
+        assert (ph["ts"] + ph["dur"]) <= (parent["ts"] + parent["dur"]) + 1.0
+
+
+def test_phase_sum_matches_step_wall_clock():
+    engine = _engine()
+    batch = _batch()
+    for _ in range(4):
+        engine.train_batch(batch)
+    s = engine.stepscope.summary()
+    assert s["steps"] == 4
+    # acceptance pin: per-phase decomposition sums to the measured step wall
+    # clock within 5% (the host residual closes the ledger by construction,
+    # so this checks the accounting stays coherent end to end)
+    assert s["phase_sum_over_step_ratio"] == pytest.approx(1.0, abs=0.05)
+    # the same invariant per step from the trace
+    events = TELEMETRY.dump_trace()["traceEvents"]
+    steps = {e["args"]["span_id"]: e for e in events
+             if e["name"] == "train/step"}
+    for sid, step_ev in steps.items():
+        kid_sum = sum(e["dur"] for e in events
+                      if e["name"].startswith("train/phase/")
+                      and e["args"].get("parent_id") == sid)
+        assert kid_sum == pytest.approx(step_ev["dur"], rel=0.05)
+
+
+def test_gauges_and_scrape():
+    engine = _engine()
+    batch = _batch()
+    for _ in range(3):
+        engine.train_batch(batch)
+    reg = TELEMETRY.registry
+    overlap = reg.gauge("train_overlap_fraction").value()
+    goodput = reg.gauge("train_goodput").value()
+    assert 0.0 <= overlap <= 1.0
+    assert 0.0 < goodput <= 1.0
+    assert reg.gauge("train_step_skew_ratio").value() == 1.0  # single host
+    assert reg.gauge("train_mfu").value() > 0.0
+    assert reg.gauge("train_flops_source").value(
+        source=engine._flops_source) == 1.0
+    assert engine._flops_source in ("analytic", "cost_analysis")
+    # goodput ledger: productive + recompile categories populated
+    c = reg.counter("train_goodput_seconds_total")
+    assert c.value(category="productive") > 0.0
+    assert c.value(category="recompile") > 0.0
+    assert c.value(category="warmup") > 0.0
+    prom = reg.render_prometheus()
+    for series in ("train_overlap_fraction", "train_goodput",
+                   "step_phase_seconds", "train_goodput_seconds_total",
+                   "train_flops_source"):
+        assert series in prom
+    # summary mirrors the gauges
+    s = engine.stepscope.summary()
+    assert s["goodput"] == pytest.approx(
+        reg.gauge("train_goodput").value(), abs=0.2)
+    assert s["goodput_seconds"]["recompile"] > 0.0
+
+
+def test_recompile_steps_excluded_from_throughput():
+    engine = _engine()
+    batch = _batch()
+    for _ in range(4):
+        engine.train_batch(batch)
+    # the first step compiled the fused program: excluded from the average
+    assert engine.tput_timer.excluded_count >= 1
+    assert engine.tput_timer.step_count >= 1
+    assert engine.tput_timer.excluded_elapsed > engine.tput_timer.total_elapsed / max(
+        engine.tput_timer.step_count, 1), "compile step should dwarf a steady step"
+
+
+def test_checkpoint_stall_accounted(tmp_path):
+    engine = _engine()
+    batch = _batch()
+    for _ in range(2):
+        engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path))
+    s = engine.stepscope.summary()
+    assert s["goodput_seconds"]["checkpoint"] > 0.0
+    events = TELEMETRY.dump_trace()["traceEvents"]
+    assert any(e["name"] == "train/checkpoint_stall" for e in events)
+
+
+def test_disabled_scope_allocates_nothing():
+    engine = _engine(stepscope=False)
+    batch = _batch()
+    engine.train_batch(batch)  # compile outside the pin
+    assert not engine.stepscope.enabled
+    tracemalloc.start()
+    try:
+        for _ in range(3):
+            engine.train_batch(batch)
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, "*/telemetry/stepscope.py")]).statistics(
+            "filename")
+    total = sum(s.size for s in stats)
+    assert total == 0, f"stepscope allocated {total}B while disabled"
+
+
+def test_summary_disabled_shape():
+    engine = _engine(stepscope=False)
+    assert engine.stepscope.summary() == {"enabled": False}

@@ -1,0 +1,74 @@
+"""ThroughputTimer: samples/sec and tflops() math, zero-elapsed guards,
+recompile-step exclusion (neither metric had direct coverage before)."""
+
+import time
+
+from deepspeed_tpu.utils.timer import ThroughputTimer
+
+
+def test_tflops_zero_before_any_step():
+    t = ThroughputTimer(batch_size=8, steps_per_output=0)
+    t.flops_per_sample = 1e9
+    assert t.throughput() == 0.0
+    assert t.tflops() == 0.0
+
+
+def test_stop_without_start_is_dropped():
+    t = ThroughputTimer(batch_size=8, steps_per_output=0)
+    t.flops_per_sample = 1e9
+    # pre-warmup misuse: stop() before any start() must not divide against
+    # the process epoch (_start == 0.0 would make total_elapsed ~ uptime)
+    t.stop(global_step=True)
+    assert t.step_count == 0
+    assert t.total_elapsed == 0.0
+    assert t.tflops() == 0.0
+
+
+def test_throughput_and_tflops_math():
+    t = ThroughputTimer(batch_size=4, steps_per_output=0)
+    t.flops_per_sample = 2e12
+    for _ in range(3):
+        t.start()
+        time.sleep(0.01)
+        t.stop(global_step=True)
+    assert t.step_count == 3
+    # samples/sec = batch * steps / elapsed
+    expected = 4 * 3 / t.total_elapsed
+    assert abs(t.throughput() - expected) < 1e-9
+    # tflops = flops_per_sample * samples_per_sec / 1e12
+    assert abs(t.tflops() - 2e12 * expected / 1e12) < 1e-6
+    assert t.tflops() > 0.0
+
+
+def test_tflops_zero_without_flops_model():
+    t = ThroughputTimer(batch_size=4, steps_per_output=0)
+    t.start()
+    time.sleep(0.005)
+    t.stop(global_step=True)
+    assert t.throughput() > 0.0
+    assert t.tflops() == 0.0
+
+
+def test_excluded_steps_do_not_pollute_average():
+    t = ThroughputTimer(batch_size=2, steps_per_output=0)
+    t.flops_per_sample = 1e12
+    # a compile-bearing step: long wall, excluded from the average
+    t.start()
+    time.sleep(0.05)
+    t.stop(global_step=True, exclude=True)
+    assert t.step_count == 0
+    assert t.excluded_count == 1
+    assert t.excluded_elapsed > 0.0
+    assert t.throughput() == 0.0
+    # last_duration still reflects the excluded step (per-step telemetry)
+    assert t.last_duration >= 0.05
+    # steady steps after it: the average sees only their wall time
+    for _ in range(2):
+        t.start()
+        time.sleep(0.005)
+        t.stop(global_step=True)
+    assert t.step_count == 2
+    assert t.total_elapsed < 0.05  # compile stall not in the denominator
+    steady = 2 * 2 / t.total_elapsed
+    assert abs(t.throughput() - steady) < 1e-9
+    assert t.tflops() > 0.0

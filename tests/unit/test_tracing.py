@@ -49,6 +49,35 @@ def test_trace_window_produces_capture(tmp_path):
     assert found, "no trace files written"
 
 
+def test_capture_survives_raising_step(tmp_path):
+    """A step that raises inside the capture window must not wedge the next
+    capture: stop_trace() is idempotent and exception-safe, and the stale
+    StepTraceAnnotation is exited on the next before_step."""
+    trace_dir = str(tmp_path / "trace")
+    engine = _engine(tmp_path, {
+        "tracing": {"enabled": True, "trace_dir": trace_dir,
+                    "start_step": 0, "num_steps": 2},
+    })
+    orig = engine._put_gas_batch
+
+    def boom(batch):
+        raise RuntimeError("injected step failure")
+
+    engine._put_gas_batch = boom
+    with pytest.raises(RuntimeError, match="injected"):
+        engine.train_batch(_batch())  # fails inside the open window
+    engine._put_gas_batch = orig
+    # the window recovers: subsequent steps run and the capture closes
+    for _ in range(3):
+        engine.train_batch(_batch())
+    # double stop: second call is a no-op, not an unmatched-stop crash
+    engine.step_tracer.stop_trace()
+    engine.step_tracer.stop_trace()
+    engine.step_tracer.close()
+    found = [f for root, _, files in os.walk(trace_dir) for f in files]
+    assert found, "no trace files written after mid-window failure"
+
+
 def test_instrument_and_ranges_run():
     calls = []
 
