@@ -1104,6 +1104,175 @@ def run_serving_subprocess(timeout: float = 900.0):
     return _run_flagged_subprocess("BENCH_SERVING", timeout)
 
 
+def disagg_bench_main():
+    """Child process: disaggregated prefill/decode serving measurement
+    (``--mode serving --disagg``, docs/SERVING.md).
+
+    Builds a one-process cluster — 1 prefill replica, 2 decode replicas
+    sharing the same params — and reports what the disagg tier adds over
+    the plain serving bench: KV-transfer volume, handoff latency, cluster
+    prefix-index hit rate, and autoscale events, plus a parity verdict
+    (cluster output token-identical to a single-replica engine, greedy AND
+    seeded). One JSON line out.
+    """
+    import http.client
+
+    import numpy as np
+    import jax
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.ragged import (
+        RaggedConfig, RaggedInferenceEngine)
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.serving import (
+        ClusterConfig, DecodeAutoscaler, EngineLoop, RouterConfig,
+        build_cluster_server)
+
+    e = os.environ
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model_cfg = llama.LlamaConfig(
+            vocab_size=32768, hidden_size=2048, intermediate_size=5632,
+            num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=1024)
+        max_new, shared, n_shared = 32, 128, 12
+        max_seqs, budget, block, max_prompt = 16, 512, 32, 512
+    else:
+        model_cfg = llama.LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=688,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256)
+        max_new, shared, n_shared = 6, 16, 4
+        max_seqs, budget, block, max_prompt = 3, 64, 8, 64
+    max_new = int(e.get("BENCH_DISAGG_MAX_NEW", max_new))
+    n_shared = int(e.get("BENCH_DISAGG_REQUESTS", n_shared))
+
+    tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "runs",
+        "BENCH_disagg_telemetry.jsonl"))
+    telemetry.configure(enabled=True, jsonl_path=tel_path, slo=True)
+
+    mbs = -(-(max_prompt + max_new) // block)
+    rcfg = RaggedConfig(
+        max_tokens_per_step=budget, max_seqs=max_seqs, block_size=block,
+        num_blocks=max_seqs * mbs + 1, max_blocks_per_seq=mbs,
+        enable_prefix_cache=True)
+
+    def mk(params=None):
+        return RaggedInferenceEngine(
+            model=lambda ctx: llama.build(model_cfg, ctx=ctx),
+            ragged_config=rcfg, seed=0, params=params)
+
+    pre = mk()
+    params = pre.params
+    frontend, cluster, loops = build_cluster_server(
+        [pre], [mk(params), mk(params)],
+        cluster_cfg=ClusterConfig(min_decode_replicas=1,
+                                  max_decode_replicas=4,
+                                  autoscale_cooldown_s=0.0),
+        router_cfg=RouterConfig(max_queue_tokens=4096))
+
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(1, model_cfg.vocab_size,
+                          (shared,), dtype=np.int32).tolist()
+
+    def post(body: dict) -> dict:
+        conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                          timeout=300)
+        conn.request("POST", "/v1/completions", body=json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"HTTP {resp.status}: {out}")
+        return out
+
+    error = None
+    parity = {}
+    try:
+        # ---- parity probe: cluster vs single-replica, greedy + seeded ---
+        ref = mk(params)
+        probe = prefix + rng.integers(
+            1, model_cfg.vocab_size, (8,), dtype=np.int32).tolist()
+        for name, sampling in (
+                ("greedy", {}),
+                ("seeded", {"temperature": 0.9, "top_k": 20, "seed": 123})):
+            ref.put(f"p-{name}", probe, max_new_tokens=max_new,
+                    temperature=sampling.get("temperature", 0.0),
+                    top_k=sampling.get("top_k", 0),
+                    seed=sampling.get("seed", 0))
+            while f"p-{name}" not in ref.finished_uids:
+                ref.step()
+            want = ref._results[f"p-{name}"].generated
+            got = post({"prompt": probe, "max_tokens": max_new,
+                        **sampling})["choices"][0]["tokens"]
+            parity[name] = bool(got == want)
+
+        # ---- shared-prefix workload: cluster-level reuse ----------------
+        t0 = time.perf_counter()
+        for i in range(n_shared):
+            tail = rng.integers(1, model_cfg.vocab_size,
+                                (8,), dtype=np.int32).tolist()
+            post({"prompt": prefix + tail, "max_tokens": max_new})
+        wall = time.perf_counter() - t0
+
+        # ---- autoscaler: forced up + down tick (policy demonstration) ---
+        def factory(name):
+            return EngineLoop(mk(params), name=name, role="decode")
+
+        scaler = DecodeAutoscaler(cluster, factory, cfg=cluster.cfg,
+                                  burn_fn=lambda: 2.0)
+        up = scaler.tick()
+        scaler._burn_fn = lambda: 0.0
+        down = scaler.tick()
+        scaler.stop()
+        autoscale_ok = up == 1 and down == -1
+    except Exception as ex:  # noqa: BLE001 - bench child must emit JSON
+        error = f"{type(ex).__name__}: {ex}"
+        wall = 0.0
+        autoscale_ok = False
+    finally:
+        cluster.begin_drain()
+        for lp in loops:
+            lp.join(timeout=60)
+        frontend.close()
+
+    cs = cluster.cluster_stats()
+    idx = cs["prefix_index"]
+    looked = idx["hits"] + idx["misses"]
+    handoffs = cs["handoffs"]["ok"] + cs["handoffs"]["failed"]
+    telemetry.TELEMETRY.close()
+    print(json.dumps({
+        "metric": "serving_disagg",
+        "error": error,
+        "disagg_parity": parity,
+        "disagg_requests": cs["disagg_requests"],
+        "disagg_completed_wall_s": round(wall, 2),
+        "kv_transfer_bytes": cs["kv_transfer"]["bytes"],
+        "kv_transfer_count": cs["kv_transfer"]["transfers"],
+        "handoffs_ok": cs["handoffs"]["ok"],
+        "handoffs_failed": cs["handoffs"]["failed"],
+        "handoff_latency_ms": round(
+            cs["handoffs"]["seconds"] / handoffs * 1e3, 2) if handoffs
+        else None,
+        "cluster_prefix_hits": idx["hits"],
+        "cluster_prefix_hit_rate": round(idx["hits"] / looked, 4)
+        if looked else 0.0,
+        "cluster_prefix_entries": idx["entries"],
+        "prefix_transfers": cs["prefix_transfers"],
+        "fallbacks": cs["fallbacks"],
+        "autoscale_events": cs["autoscale_events"],
+        "autoscale_up_down_ok": autoscale_ok,
+        "replica_roles": cs["roles"],
+        "backend": jax.default_backend(),
+        "telemetry_jsonl": tel_path,
+    }))
+    return 0 if error is None else 1
+
+
+def run_disagg_subprocess(timeout: float = 900.0):
+    return _run_flagged_subprocess("BENCH_SERVING_DISAGG", timeout)
+
+
 def chaos_bench_main():
     try:
         return _chaos_bench_impl()
@@ -1607,6 +1776,18 @@ def main():
                   "supported: serving, decode-steady, chaos, train-anatomy",
                   file=sys.stderr)
             return 2
+        if "--disagg" in sys.argv:
+            # disaggregated prefill/decode cluster trial (docs/SERVING.md):
+            # parity verdict, KV-transfer volume, handoff latency, cluster
+            # prefix hit rate, autoscale policy check
+            result, err = run_disagg_subprocess()
+            if result is None:
+                print(f"disagg bench failed:\n{_err_text(err)}",
+                      file=sys.stderr)
+                _fail_json(err)
+                return 1
+            print(json.dumps(result))
+            return 0 if result.get("error") is None else 1
         if "--shared-prefix-tokens" in sys.argv:
             # shared-prompt workload: prompts share an N-token prefix and
             # the engine serves with the block-level prefix cache enabled
@@ -1630,6 +1811,9 @@ def main():
         # no jit cache: the chaos child runs a deliberately tiny model and
         # must not pollute the shared compile cache with fault-path programs
         return chaos_bench_main()
+    if os.environ.get("BENCH_SERVING_DISAGG"):
+        _enable_jit_cache()
+        return disagg_bench_main()
     if os.environ.get("BENCH_SERVING"):
         _enable_jit_cache()
         return serving_bench_main()

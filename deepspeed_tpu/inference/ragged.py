@@ -78,6 +78,10 @@ class BlockedAllocator:
         self._keys: dict[int, Any] = {}  # block id -> its chain key
         self._lru: dict[int, None] = {}  # refcount-0 published blocks, LRU->MRU
         self.evictions = 0  # cumulative cached blocks reclaimed under pressure
+        # optional publish/evict listener (serving cluster prefix index):
+        # an object with on_publish(key) / on_evict(key), called on the
+        # engine thread as keys enter/leave the index. None = standalone.
+        self.listener = None
 
     @property
     def free_blocks(self) -> int:
@@ -110,9 +114,12 @@ class BlockedAllocator:
     def _evict_lru(self) -> None:
         b = next(iter(self._lru))  # oldest entry (LRU order)
         del self._lru[b]
-        del self._index[self._keys.pop(b)]
+        key = self._keys.pop(b)
+        del self._index[key]
         self._free.append(b)
         self.evictions += 1
+        if self.listener is not None:
+            self.listener.on_evict(key)
 
     def free(self, blocks: list[int]) -> None:
         """Drop one reference per block; a block reaching refcount 0 returns
@@ -152,6 +159,8 @@ class BlockedAllocator:
             return False
         self._index[key] = block
         self._keys[block] = key
+        if self.listener is not None:
+            self.listener.on_publish(key)
         return True
 
 
@@ -283,6 +292,18 @@ class _SeqState:
     # non-None while the tracer is enabled AND this request was sampled, so
     # ``seq.trace is not None`` is the complete hot-path guard
     trace: Any = None
+    # disaggregated serving (serving/cluster.py): a prefill-stage request.
+    # The engine runs the prompt plus the FIRST token only, then parks the
+    # sequence (KV blocks held, slot freed) until export_handoff() gathers
+    # the blocks into a KVHandoff record for a decode replica to import.
+    # ``handoff_budget`` carries the request's FULL max_new_tokens through
+    # to the record (the prefill stage itself runs with max_new_tokens=1).
+    handoff: bool = False
+    handoff_budget: int = 0
+    # the cached-prefix token count the router credited at placement time
+    # (advisory probe); admission re-validates the actual splice against it
+    # and counts the shortfall instead of over-crediting (stale-probe fix)
+    expected_cached: int = 0
 
     def token_at(self, p: int) -> int:
         if p < len(self.prompt):
@@ -302,6 +323,80 @@ class _SeqState:
         if len(self.generated) >= self.max_new_tokens:
             return True
         return bool(self.generated) and self.generated[-1] == self.eos_token_id
+
+
+@dataclass
+class KVHandoff:
+    """Compact prefill→decode handoff record for disaggregated serving.
+
+    Produced by ``export_handoff`` on a prefill replica after the prompt
+    (plus the first generated token) has run; consumed by ``import_handoff``
+    on a decode replica, which allocates fresh blocks, scatters the payloads,
+    and resumes decode token-identically (per-request sampling keys depend
+    only on (seed, gen_idx), never on the engine).
+
+    The record is deliberately transport-agnostic: plain numpy payloads, the
+    device-row snapshot in the PR-4 slot-row format (``row_iv``/``row_fv``
+    mirror ``_write_slot_row``'s packed int/float planes), and primitive
+    request metadata — an RDMA/ICI channel can serialize it without touching
+    engine internals. The in-memory channel just passes the object through.
+    """
+
+    uid: Any
+    prompt: list[int]
+    generated: list[int]        # tokens emitted by the prefill stage (>= 1)
+    pos: int                    # KV scheduled for positions [0, pos)
+    max_new_tokens: int         # the DECODE side's budget (full request)
+    eos_token_id: int | None
+    temperature: float
+    top_k: int
+    top_p: float
+    seed: int                   # effective per-request sampling seed
+    deadline_remaining_s: float  # seconds of deadline left at export (0 = none)
+    # KV payload covering ceil(pos / block_size) blocks: a pytree mirroring
+    # the engine's paged cache with each leaf sliced to the exported blocks
+    # along axis 1 ([num_layers, n_blocks, block_size, ...] per leaf), as
+    # host numpy arrays
+    block_payload: Any = None
+    # device-row snapshot (PR-4 dirty-row format): int plane
+    # (tok, pos, seed, prompt_len, top_k) + float plane (temperature, top_p)
+    row_iv: np.ndarray = None
+    row_fv: np.ndarray = None
+
+    @property
+    def n_blocks(self) -> int:
+        leaves = jax.tree_util.tree_leaves(self.block_payload)
+        return int(leaves[0].shape[1]) if leaves else 0
+
+    @property
+    def nbytes(self) -> int:
+        n = sum(int(a.nbytes)
+                for a in jax.tree_util.tree_leaves(self.block_payload))
+        for a in (self.row_iv, self.row_fv):
+            if a is not None:
+                n += a.nbytes
+        return n
+
+
+@dataclass
+class PrefixPayload:
+    """Published prefix-cache blocks in transferable form: the prompt slice
+    they cover plus their KV payloads. ``import_prefix`` re-derives the hash
+    chain from the tokens (exact tuples, same keying as the local index) so
+    a transferred block can never splice under the wrong key."""
+
+    tokens: list[int]        # the covered block-aligned prompt prefix
+    block_payload: Any = None  # cache pytree, leaves [L, n_blocks, bs, ...]
+
+    @property
+    def n_blocks(self) -> int:
+        leaves = jax.tree_util.tree_leaves(self.block_payload)
+        return int(leaves[0].shape[1]) if leaves else 0
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes)
+                   for a in jax.tree_util.tree_leaves(self.block_payload))
 
 
 class RaggedInferenceEngine:
@@ -451,7 +546,18 @@ class RaggedInferenceEngine:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_tokens_reused = 0
+        self.prefix_stale_probes = 0  # admissions whose splice came up short
         self._evictions_seen = 0  # high-water for the eviction counter delta
+        # ---- disaggregated serving (serving/cluster.py) ----
+        # finished prefill-stage sequences whose KV blocks are parked for
+        # export_handoff(); cluster prefix-index listener survives
+        # reset_state() by being reinstalled on the fresh allocator
+        self._handoffs: dict[Any, _SeqState] = {}
+        self._prefix_listener = None
+        self._kv_gather_jits: dict[int, Any] = {}
+        self._kv_scatter_jits: dict[int, Any] = {}
+        self.kv_blocks_exported = 0
+        self.kv_blocks_imported = 0
         if self.cfg.fused_chunk == 1 or self.cfg.fused_chunk < 0:
             raise ValueError("fused_chunk must be 0 (off) or >= 2")
         if self.cfg.fused_chunk and self.cfg.pipeline_depth < 1:
@@ -506,7 +612,9 @@ class RaggedInferenceEngine:
             eos_token_id: int | None = None, temperature: float = 0.0,
             top_k: int = 0, top_p: float = 1.0,
             deadline_s: float | None = None,
-            seed: int | None = None, trace=None) -> None:
+            seed: int | None = None, trace=None,
+            handoff: bool = False,
+            expected_cached_tokens: int = 0) -> None:
         """Enqueue a request (reference ``engine_v2.py put()``). Admission into
         the running batch happens inside ``step()`` as slots/budget free up.
         ``temperature``/``top_k``/``top_p`` select per-request sampling
@@ -529,7 +637,11 @@ class RaggedInferenceEngine:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        total = len(prompt) + max_new_tokens
+        # a prefill-stage (handoff) request runs prompt + ONE token here;
+        # the decode replica that imports the record owns the full budget
+        # (and re-validates it against its own caps at import)
+        eff_new = 1 if handoff else max_new_tokens
+        total = len(prompt) + eff_new
         if total > self.cfg.max_seq_len:
             raise ValueError(
                 f"request length {total} exceeds engine max_seq_len "
@@ -566,13 +678,15 @@ class RaggedInferenceEngine:
         else:
             trace_ctx = None
         self._queued.append(_SeqState(
-            uid=uid, prompt=prompt, max_new_tokens=max_new_tokens,
+            uid=uid, prompt=prompt, max_new_tokens=eff_new,
             eos_token_id=eos_token_id if eos_token_id is not None else self.eos_token_id,
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), seed=eff_seed,
             deadline=(time.perf_counter() + deadline_s) if deadline_s else 0.0,
             t_enqueue=time.perf_counter() if self.telemetry.enabled else 0.0,
             trace=trace_ctx,
+            handoff=bool(handoff), handoff_budget=int(max_new_tokens),
+            expected_cached=max(0, int(expected_cached_tokens)),
         ))
         if self.telemetry.enabled:
             self.telemetry.counter(
@@ -701,6 +815,280 @@ class RaggedInferenceEngine:
             key = (key, tuple(seq.prompt[i * bs:(i + 1) * bs]))
             self.allocator.publish(seq.blocks[i], key)
 
+    # ------------------------------------- KV transfer (disaggregated serving)
+    def set_prefix_listener(self, listener) -> None:
+        """Attach a publish/evict listener (the cluster prefix index) to the
+        allocator; survives ``reset_state`` (reinstalled on the fresh
+        allocator, with ``listener.on_reset()`` telling the index to drop
+        this replica's entries)."""
+        self._prefix_listener = listener
+        self.allocator.listener = listener
+
+    def kv_bytes_per_token(self) -> int:
+        """Bytes of paged-cache state one token position occupies across all
+        cache leaves — the bytes side of the transfer-vs-prefill cost model."""
+        bs = self.cfg.block_size
+        total = 0
+        for a in jax.tree_util.tree_leaves(self.cache):
+            per_block = int(a.shape[0]) * int(np.prod(a.shape[2:])) \
+                * a.dtype.itemsize
+            total += per_block // bs
+        return total
+
+    def _kv_jits(self):
+        if "g" not in self._kv_gather_jits:
+            self._kv_gather_jits["g"] = jax.jit(
+                lambda c, i: jax.tree_util.tree_map(lambda a: a[:, i], c))
+            # donated: the scatter replaces self.cache in place
+            self._kv_scatter_jits["s"] = jax.jit(
+                lambda c, i, p: jax.tree_util.tree_map(
+                    lambda a, pa: a.at[:, i].set(pa.astype(a.dtype)), c, p),
+                donate_argnums=(0,))
+        return self._kv_gather_jits["g"], self._kv_scatter_jits["s"]
+
+    def _gather_blocks(self, blocks: list[int]):
+        """Read the KV rows of ``blocks`` back to host numpy (pow2-bucketed
+        index so the gather compiles O(log max_blocks_per_seq) times; pad
+        rows re-read the scratch block and are sliced off)."""
+        g, _ = self._kv_jits()
+        n = len(blocks)
+        r = 1
+        while r < n:
+            r *= 2
+        idx = np.zeros(r, np.int32)
+        idx[:n] = blocks
+        out = g(self.cache, jnp.asarray(idx))
+        return jax.tree_util.tree_map(lambda a: np.asarray(a[:, :n]), out)
+
+    def _scatter_blocks(self, blocks: list[int], payload) -> None:
+        """Write transferred KV payloads into ``blocks`` (donated in-place
+        update of the paged cache; pad rows land in the scratch block)."""
+        _, s = self._kv_jits()
+        n = len(blocks)
+        r = 1
+        while r < n:
+            r *= 2
+        idx = np.zeros(r, np.int32)
+        idx[:n] = blocks
+        if r != n:
+            payload = jax.tree_util.tree_map(
+                lambda a: np.concatenate(
+                    [a, np.zeros((a.shape[0], r - n) + a.shape[2:], a.dtype)],
+                    axis=1),
+                payload)
+        self.h2d_bytes += idx.nbytes + sum(
+            int(a.nbytes) for a in jax.tree_util.tree_leaves(payload))
+        self.cache = s(self.cache, jnp.asarray(idx), payload)
+
+    def export_handoff(self, uid) -> KVHandoff | None:
+        """Turn a finished prefill-stage request (``put(handoff=True)``) into
+        a transferable KVHandoff record, then retire its blocks locally
+        (publishing the prompt blocks into this replica's prefix cache
+        first, exactly like a normal retirement). None if ``uid`` has no
+        parked handoff state (cancelled / timed out / already exported)."""
+        seq = self._handoffs.pop(uid, None)
+        if seq is None:
+            return None
+        bs = self.cfg.block_size
+        # canonical resume point: feeding token_at(pos) at position pos
+        # produces generated index pos - len(prompt) + 1, so the decode
+        # side must resume one position behind the newest emitted token.
+        # (Speculative dispatch may have scheduled KV further; re-writing
+        # that cell on resume is masked until the position is reached.)
+        pos = len(seq.prompt) + len(seq.generated) - 1
+        n_ctx = -(-pos // bs)
+        payload = self._gather_blocks(seq.blocks[:n_ctx])
+        self.kv_blocks_exported += n_ctx
+        tok = seq.token_at(pos) if pos >= len(seq.prompt) else 0
+        iv = np.asarray([tok, pos, seq.seed, len(seq.prompt), seq.top_k],
+                        np.int32)
+        fv = np.asarray([seq.temperature, seq.top_p], np.float32)
+        rem = (max(0.0, seq.deadline - time.perf_counter())
+               if seq.deadline else 0.0)
+        rec = KVHandoff(
+            uid=seq.uid, prompt=list(seq.prompt),
+            generated=list(seq.generated), pos=pos,
+            max_new_tokens=seq.handoff_budget or seq.max_new_tokens,
+            eos_token_id=seq.eos_token_id, temperature=seq.temperature,
+            top_k=seq.top_k, top_p=seq.top_p, seed=seq.seed,
+            deadline_remaining_s=rem, block_payload=payload,
+            row_iv=iv, row_fv=fv)
+        if self.cfg.enable_prefix_cache:
+            self._publish_prompt_blocks(seq)
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        if self.telemetry.enabled:
+            self.telemetry.counter(
+                "kv_transfer_blocks_total",
+                "KV blocks moved by handoff/prefix transfers",
+            ).inc(n_ctx, direction="export")
+        return rec
+
+    def discard_handoff(self, uid) -> bool:
+        """Release a parked handoff without exporting it (the cluster's
+        failure paths: transfer cancelled, decode side gone)."""
+        seq = self._handoffs.pop(uid, None)
+        if seq is None:
+            return False
+        if self.cfg.enable_prefix_cache:
+            self._publish_prompt_blocks(seq)
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        return True
+
+    def import_handoff(self, h: KVHandoff) -> bool:
+        """Adopt a prefill replica's handoff: allocate fresh blocks, scatter
+        the KV payload, seed the slot's device row from the record's PR-4
+        row snapshot, and resume decode token-identically. Returns False
+        when no slot or insufficient unreserved blocks are available right
+        now (the cluster falls back to a cold submit); raises ValueError for
+        requests this engine could never serve."""
+        cfg = self.cfg
+        bs = cfg.block_size
+        prompt = [int(t) for t in h.prompt]
+        total = len(prompt) + int(h.max_new_tokens)
+        if total > cfg.max_seq_len:
+            raise ValueError(
+                f"handoff length {total} exceeds engine max_seq_len "
+                f"{cfg.max_seq_len}")
+        worst = -(-total // bs)
+        if worst > min(cfg.num_blocks - 1, cfg.max_blocks_per_seq):
+            raise ValueError(
+                f"handoff needs {worst} KV blocks but at most "
+                f"{min(cfg.num_blocks - 1, cfg.max_blocks_per_seq)} are "
+                "available per sequence")
+        pos = int(h.pos)
+        n_ctx = -(-pos // bs)
+        if h.n_blocks != n_ctx:
+            raise ValueError(
+                f"handoff payload covers {h.n_blocks} blocks but pos={pos} "
+                f"needs {n_ctx}")
+        if not self._free_slots:
+            return False
+        if worst > self.allocator.free_blocks - self._reserved:
+            return False
+        seq = _SeqState(
+            uid=h.uid, prompt=prompt, max_new_tokens=int(h.max_new_tokens),
+            eos_token_id=h.eos_token_id, temperature=float(h.temperature),
+            top_k=int(h.top_k), top_p=float(h.top_p), seed=int(h.seed),
+            generated=list(h.generated), pos=pos,
+            deadline=(time.perf_counter() + h.deadline_remaining_s)
+            if h.deadline_remaining_s else 0.0,
+            t_enqueue=time.perf_counter() if self.telemetry.enabled else 0.0,
+        )
+        self._results.pop(h.uid, None)  # supersede any stale retired record
+        blocks = self.allocator.allocate(n_ctx)
+        self._scatter_blocks(blocks, h.block_payload)
+        self.kv_blocks_imported += n_ctx
+        if self.telemetry.enabled:
+            self.telemetry.counter(
+                "kv_transfer_blocks_total",
+                "KV blocks moved by handoff/prefix transfers",
+            ).inc(n_ctx, direction="import")
+        seq.blocks = blocks
+        if seq.finished:
+            # the prefill stage already hit EOS (or the budget was 1):
+            # nothing to decode — retire immediately, seeding the local
+            # prefix cache with the transferred prompt blocks
+            if cfg.enable_prefix_cache:
+                self._publish_prompt_blocks(seq)
+            self.allocator.free(blocks)
+            seq.blocks = []
+            self._results[seq.uid] = seq
+            return True
+        slot = self._free_slots.pop()
+        seq.slot = slot
+        seq.reserved_remaining = worst - n_ctx
+        self._reserved += seq.reserved_remaining
+        self.block_tables[slot, :] = 0
+        self.block_tables[slot, :n_ctx] = blocks
+        self._bt_dirty.add(slot)
+        self._slot_feed[slot] = False
+        self._running[slot] = seq
+        if cfg.device_state:
+            # the record's device-row snapshot IS the slot row (PR-4 format);
+            # only the slot index is local
+            iv = np.asarray(h.row_iv, np.int32)
+            fv = np.asarray(h.row_fv, np.float32)
+            self.h2d_bytes += iv.nbytes + fv.nbytes + 4
+            self._dev_state = self._slot_row_jit(
+                self._dev_state, np.int32(slot), iv, fv)
+        return True
+
+    def export_prefix(self, prompt_tokens) -> PrefixPayload | None:
+        """Export the longest locally-cached full-block prefix of a prompt
+        as a transferable payload (cluster prefix transfer: the holder
+        ships published blocks to the replica the router actually picked).
+        None when nothing is cached."""
+        if not self.cfg.enable_prefix_cache:
+            return None
+        prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
+        if not prompt:
+            return None
+        hit = self._match_prefix(prompt)
+        if not hit:
+            return None
+        self.allocator.acquire(hit)  # pin against eviction during the gather
+        try:
+            payload = self._gather_blocks(hit)
+        finally:
+            self.allocator.free(hit)
+        self.kv_blocks_exported += len(hit)
+        if self.telemetry.enabled:
+            self.telemetry.counter(
+                "kv_transfer_blocks_total",
+                "KV blocks moved by handoff/prefix transfers",
+            ).inc(len(hit), direction="export")
+        return PrefixPayload(
+            tokens=prompt[:len(hit) * self.cfg.block_size],
+            block_payload=payload)
+
+    def import_prefix(self, payload: PrefixPayload | None) -> int:
+        """Install transferred prefix blocks into the local prefix cache
+        (allocate → scatter → publish under the re-derived hash chain →
+        refcount-0 into the evictable LRU, so the import stays strictly
+        free-memory-funded). Returns the contiguous-from-root token count
+        now cached locally. Already-published chain links are kept (dedupe);
+        imports past the unreserved budget are dropped, never forced."""
+        if payload is None or not self.cfg.enable_prefix_cache:
+            return 0
+        bs = self.cfg.block_size
+        tokens = [int(t) for t in payload.tokens]
+        n = min(payload.n_blocks, len(tokens) // bs)
+        alloc = self.allocator
+        keys = []
+        missing = []
+        key = None
+        for i in range(n):
+            key = (key, tuple(tokens[i * bs:(i + 1) * bs]))
+            keys.append(key)
+            if alloc.lookup(key) is None:
+                missing.append(i)
+        budget = max(0, alloc.free_blocks - self._reserved)
+        missing = missing[:budget]
+        if missing:
+            blocks = alloc.allocate(len(missing))
+            midx = np.asarray(missing)
+            self._scatter_blocks(
+                blocks,
+                jax.tree_util.tree_map(lambda a: a[:, midx],
+                                       payload.block_payload))
+            for b, i in zip(blocks, missing):
+                alloc.publish(b, keys[i])
+            alloc.free(blocks)  # refcount 0 + published -> evictable LRU
+            self.kv_blocks_imported += len(blocks)
+            if self.telemetry.enabled:
+                self.telemetry.counter(
+                    "kv_transfer_blocks_total",
+                    "KV blocks moved by handoff/prefix transfers",
+                ).inc(len(blocks), direction="import")
+        m = 0
+        for k in keys:
+            if alloc.lookup(k) is None:
+                break
+            m += 1
+        return m * bs
+
     def _ensure_capacity(self, seq: _SeqState, upto: int) -> bool:
         """Grow seq's block table to cover positions [0, upto); False if the
         pool can't satisfy it right now. Admitted sequences draw from their
@@ -733,6 +1121,21 @@ class RaggedInferenceEngine:
     def _release(self, seq: _SeqState) -> None:
         self._reserved -= seq.reserved_remaining  # return unused reservation
         seq.reserved_remaining = 0
+        if seq.handoff and seq.status == "finished":
+            # prefill-stage retirement: PARK the KV blocks (refcounts held)
+            # for export_handoff() instead of freeing them — only the slot
+            # and reservation return to the pool. Cancel/timeout/error paths
+            # fall through to the normal free below.
+            self.block_tables[seq.slot, :] = 0
+            self._bt_dirty.add(seq.slot)
+            self._free_slots.append(seq.slot)
+            del self._running[seq.slot]
+            seq.slot = -1
+            self._handoffs[seq.uid] = seq
+            self._results[seq.uid] = seq
+            if self.telemetry.enabled:
+                self._emit_request_span(seq)
+            return
         if self.cfg.enable_prefix_cache:
             # publish BEFORE free: blocks whose last referent drops here land
             # in the evictable LRU instead of the free list
@@ -2211,6 +2614,19 @@ class RaggedInferenceEngine:
                     self.allocator.free(hit)
                 break  # pool pressure: retry admission as blocks free up
             self._queued.pop(0)
+            if seq.expected_cached and len(hit) * self.cfg.block_size \
+                    < seq.expected_cached:
+                # the placement-time cached_prefix_tokens probe promised more
+                # splice than admission found (LRU eviction in between):
+                # proceed as a cold/shorter prefill — the re-match above IS
+                # the re-validation — and make the over-credit observable
+                self.prefix_stale_probes += 1
+                if self.telemetry.enabled:
+                    self.telemetry.counter(
+                        "prefix_probe_stale_total",
+                        "admissions whose placement-time prefix probe "
+                        "over-credited cached_tokens",
+                    ).inc()
             seq.slot = self._free_slots.pop()
             seq.reserved_remaining = worst
             self._reserved += worst
@@ -2491,12 +2907,24 @@ class RaggedInferenceEngine:
             failed += 1
             if self.telemetry.enabled:
                 self._emit_request_span(seq)
+        for seq in self._handoffs.values():
+            seq.status = "error"
+            seq.blocks = []
+            seq.slot = -1
+            self._results[seq.uid] = seq
+            failed += 1
+        self._handoffs.clear()
         self._queued = []
         self._running = {}
         self._pending.clear()
         self._inflight_chunks.clear()
         self._staging_cache.clear()
         self.allocator = BlockedAllocator(self.cfg.num_blocks)
+        if self._prefix_listener is not None:
+            # fresh allocator has no published keys: tell the cluster index
+            # to forget this replica, then keep listening
+            self.allocator.listener = self._prefix_listener
+            self._prefix_listener.on_reset()
         self.block_tables[:] = 0
         self._bt_dirty.clear()
         self._bt_dev = jnp.asarray(self.block_tables)

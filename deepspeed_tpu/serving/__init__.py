@@ -15,10 +15,22 @@ MII-role tier, stdlib-only:
 - :mod:`faults` — deterministic fault-injection harness (named injection
   points at the real seams; drives the dispatch watchdog, crash
   containment, and replica-failover machinery — docs/FAULT_TOLERANCE.md)
+- :mod:`cluster` — disaggregated prefill/decode serving: role-tagged
+  replicas, KV-handoff transfer, a cluster-wide prefix index, and an
+  SLO-burn-driven decode-pool autoscaler
 
 See docs/SERVING.md for the architecture walkthrough.
 """
 
+from deepspeed_tpu.serving.cluster import (  # noqa: F401
+    ClusterConfig,
+    ClusterPrefixIndex,
+    DecodeAutoscaler,
+    InMemoryTransferChannel,
+    ServingCluster,
+    build_cluster_server,
+    transfer_beats_prefill,
+)
 from deepspeed_tpu.serving.engine_loop import (  # noqa: F401
     EngineLoop,
     ReplicaDraining,

@@ -130,6 +130,10 @@ class ReplicaStats:
     degraded: int = 0         # engine degraded_mode rung (0 = full path)
     crashes: int = 0          # step exceptions contained by the loop
     respawns: int = 0         # loop-thread deaths survived by respawn
+    # disaggregated serving: "prefill" replicas only run prompt stages
+    # (handoff exports), "decode" replicas adopt handoffs; "unified" does
+    # everything. plan_placement() filters on this.
+    role: str = "unified"
 
     def worst_blocks(self, total_tokens: int) -> int:
         return -(-total_tokens // self.block_size)
@@ -149,9 +153,13 @@ class EngineLoop:
     """Background driver for one RaggedInferenceEngine replica."""
 
     def __init__(self, engine, name: str = "replica-0",
-                 idle_wait_s: float = 0.002, max_respawns: int = 3):
+                 idle_wait_s: float = 0.002, max_respawns: int = 3,
+                 role: str = "unified"):
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
         self._engine = engine
         self.name = name
+        self.role = role
         self._idle_wait_s = float(idle_wait_s)
         self._max_respawns = int(max_respawns)
         self._faults = get_fault_injector()
@@ -169,6 +177,10 @@ class EngineLoop:
         self._pending_blocks = 0
         self._pending_tokens = 0
         self._open: dict[str, _Open] = {}
+        # cross-thread engine calls (cluster KV export/import): the loop
+        # thread runs each entry's first element against the engine; the
+        # second is the drop handler invoked if the loop dies first
+        self._pending_calls: list = []
         self._wake = threading.Event()
         self._draining = threading.Event()
         self._stopped = threading.Event()
@@ -265,6 +277,93 @@ class EngineLoop:
         except Exception:  # noqa: BLE001 - advisory: racing a mutation is fine
             return 0
 
+    # --------------------------------- cross-thread engine calls (cluster)
+    def call(self, fn, timeout: float | None = 30.0):
+        """Run ``fn(engine)`` on the loop thread and return its result.
+
+        The engine is single-owner (the loop thread does every ``engine.*``
+        call), so the cluster's KV handoff/prefix transfers go through here
+        instead of touching the engine directly. On a loop whose thread was
+        never started the call runs inline (the caller is the only owner —
+        the unit-test convenience). Raises ``fn``'s exception, TimeoutError
+        past ``timeout``, or RuntimeError if the loop dies/exits before
+        servicing the call."""
+        if self._stopped.is_set():
+            raise RuntimeError(f"{self.name}: loop is stopped")
+        if self._thread.ident is None:
+            return fn(self._engine)
+        box: dict = {}
+        done = threading.Event()
+
+        def run(eng):
+            try:
+                box["value"] = fn(eng)
+            except BaseException as e:  # noqa: BLE001 - re-raised at caller
+                box["exc"] = e
+            finally:
+                done.set()
+
+        def drop(msg: str):
+            box["exc"] = RuntimeError(msg)
+            done.set()
+
+        with self._lock:
+            self._pending_calls.append((run, drop))
+        self._wake.set()
+        if not done.wait(timeout):
+            raise TimeoutError(
+                f"{self.name}: engine call not serviced within {timeout}s")
+        if "exc" in box:
+            raise box["exc"]
+        return box.get("value")
+
+    def adopt(self, req: CompletionRequest, handoff) -> TokenStream:
+        """Adopt a prefill replica's handoff record as a live request.
+
+        The loop thread imports the KV payload (``engine.import_handoff``);
+        the returned stream then carries the WHOLE generation — the prefill
+        stage's first token included, since delivery starts at generated
+        index 0 and the record's ``generated`` seeds it. On rejection (no
+        slot/blocks right now, or a record this engine can never fit) the
+        stream fails with ``reason="import_rejected"`` so the cluster can
+        fall back to a cold submit."""
+        if self._draining.is_set():
+            raise ReplicaDraining(f"{self.name} is draining")
+        stream = TokenStream(req.request_id)
+        rid = req.request_id
+
+        def _do(eng):
+            if self._draining.is_set():
+                stream._fail(f"{self.name} is draining", code=503,
+                             reason="import_rejected")
+                return
+            try:
+                ok = eng.import_handoff(handoff)
+            except Exception as e:  # noqa: BLE001 - structurally unservable
+                stream._fail(f"handoff import failed on {self.name}: {e}",
+                             code=503, reason="import_rejected")
+                return
+            if not ok:
+                stream._fail(
+                    f"{self.name}: no slot/blocks to adopt handoff {rid}",
+                    code=503, reason="import_rejected")
+                return
+            self._open[rid] = _Open(stream)
+
+        def drop(msg: str):
+            stream._fail(msg, code=503, reason="replica_died")
+
+        if self._stopped.is_set():
+            drop(f"{self.name}: loop is stopped")
+            return stream
+        if self._thread.ident is None:
+            _do(self._engine)
+            return stream
+        with self._lock:
+            self._pending_calls.append((_do, drop))
+        self._wake.set()
+        return stream
+
     # --------------------------------------------------------------- stats
     def stats(self) -> ReplicaStats:
         queued, inflight, outstanding, free = self._engine_stats
@@ -282,7 +381,8 @@ class EngineLoop:
             max_request_blocks=self._max_request_blocks,
             max_request_tokens=self._max_request_tokens,
             degraded=int(getattr(self._engine, "degraded_mode", 0)),
-            crashes=self.crash_count, respawns=self.respawn_count)
+            crashes=self.crash_count, respawns=self.respawn_count,
+            role=self.role)
 
     # ------------------------------------------------------- loop internals
     def _drain_inbox(self) -> None:
@@ -323,7 +423,10 @@ class EngineLoop:
                             eos_token_id=req.eos_token_id,
                             temperature=req.temperature, top_k=req.top_k,
                             top_p=req.top_p, deadline_s=req.deadline_s,
-                            seed=req.seed, trace=req.trace_ctx)
+                            seed=req.seed, trace=req.trace_ctx,
+                            handoff=getattr(req, "handoff", False),
+                            expected_cached_tokens=getattr(
+                                req, "cached_tokens_hint", 0))
                     self._open[rid] = _Open(stream)
                 except ValueError as e:
                     stream._fail(str(e))
@@ -403,10 +506,23 @@ class EngineLoop:
         self._engine.reset_state()
         self._publish_stats()
 
+    def _drain_calls(self) -> None:
+        with self._lock:
+            calls, self._pending_calls = self._pending_calls, []
+        for run, _ in calls:
+            run(self._engine)  # run() boxes fn's exceptions for the caller
+
+    def _drop_calls(self, msg: str) -> None:
+        with self._lock:
+            calls, self._pending_calls = self._pending_calls, []
+        for _, drop in calls:
+            drop(msg)
+
     def _run_loop(self) -> None:
         eng = self._engine
         while True:
             self._drain_inbox()
+            self._drain_calls()
             if eng.has_work:
                 if self._faults.enabled:
                     # outside the try: an injected loop fault kills the
@@ -426,7 +542,8 @@ class EngineLoop:
             self._deliver()
             self._publish_stats()
             with self._lock:
-                idle = not self._inbox and not self._cancel_ids
+                idle = (not self._inbox and not self._cancel_ids
+                        and not self._pending_calls)
             if idle and self._draining.is_set():
                 return
             self._wake.wait(self._idle_wait_s)
@@ -441,6 +558,7 @@ class EngineLoop:
             self._pending_blocks = self._pending_tokens = 0
         for _, _, _, stream in items:
             stream._fail(msg, code=code, reason=reason)
+        self._drop_calls(msg)
 
     def _run(self) -> None:
         try:
@@ -482,3 +600,4 @@ class EngineLoop:
         self._alive = False
         self._draining.set()  # a dead replica must not admit
         self._stopped.set()
+        self._drop_calls(f"{self.name}: loop exited")

@@ -150,6 +150,11 @@ def _make_handler(frontend: ServingFrontend):
             if path == "/healthz":
                 state = router.state()
                 payload = {"status": state, "replicas": router.health()}
+                cluster_stats = getattr(router, "cluster_stats", None)
+                if cluster_stats is not None:
+                    # a ServingCluster fronts the router: expose roles,
+                    # prefix-index coverage, handoff/fallback counters
+                    payload["cluster"] = cluster_stats()
                 slo = get_telemetry().slo
                 if slo is not None:
                     payload["slo"] = slo.health()

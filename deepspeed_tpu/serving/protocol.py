@@ -63,6 +63,13 @@ class CompletionRequest:
     # the inbox-wait span retroactively
     trace_ctx: object = field(default=None, repr=False, compare=False)
     t_submit: float = field(default=0.0, repr=False, compare=False)
+    # not wire fields (disaggregated serving, serving/cluster.py): the
+    # cluster marks the prefill-stage copy of a request with ``handoff`` so
+    # the engine parks its KV for export instead of decoding, and the router
+    # stamps the placement-time prefix-probe credit in ``cached_tokens_hint``
+    # so admission can re-validate the splice (stale-probe fix)
+    handoff: bool = field(default=False, repr=False, compare=False)
+    cached_tokens_hint: int = field(default=0, repr=False, compare=False)
 
     def __post_init__(self):
         _require(isinstance(self.prompt, (list, tuple)) and len(self.prompt) > 0,

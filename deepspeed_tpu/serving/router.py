@@ -23,6 +23,7 @@ math is unit-testable without sockets or threads.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, replace
 
@@ -103,6 +104,7 @@ class _ReplicaHealth:
 def plan_placement(
     stats: list[ReplicaStats], total_tokens: int, cfg: RouterConfig,
     cached_tokens: list[int] | None = None,
+    roles: tuple = ("unified", "decode"),
 ) -> tuple[int | None, str]:
     """Pure admission/placement decision over a stats snapshot.
 
@@ -113,11 +115,19 @@ def plan_placement(
     amount — a replica holding the prefix admits requests a cold one must
     queue, and ties prefer the replica that reuses the most.
 
+    ``roles`` restricts which replica roles may take the request. The
+    default excludes "prefill": a dedicated prefill replica only ever runs
+    handoff prompt stages the cluster places explicitly, so neither initial
+    placement NOR failover resubmission can land a decode-bearing request
+    on it (the never-fail-over-to-prefill invariant — resubmit() goes
+    through this same function).
+
     Returns ``(replica_index, verdict)`` where verdict is one of
     ``"admit"`` (free KV blocks now), ``"queue"`` (fits under the queue
     bound), ``"draining"`` / ``"overloaded"`` (index is None).
     """
-    live = [(i, s) for i, s in enumerate(stats) if s.alive and not s.draining]
+    live = [(i, s) for i, s in enumerate(stats)
+            if s.alive and not s.draining and s.role in roles]
     if not live:
         return None, "draining"
 
@@ -168,6 +178,39 @@ class ReplicaRouter:
         self._failovers: dict[str, int] = {}
         self._faults = get_fault_injector()
         self._draining = False
+        # guards the replicas/_health pair against autoscaler mutation;
+        # every read path works on a _snapshot() so a concurrent
+        # add/remove never shifts indices mid-decision
+        self._replica_lock = threading.Lock()
+
+    # ------------------------------------------- replica pool (autoscaler)
+    def _snapshot(self) -> tuple[list[EngineLoop], list[_ReplicaHealth]]:
+        with self._replica_lock:
+            return list(self.replicas), list(self._health)
+
+    def add_replica(self, replica: EngineLoop) -> None:
+        """Grow the pool (autoscaler scale-up). The new replica starts with
+        a fresh closed breaker and is placeable on the next submit."""
+        with self._replica_lock:
+            self.replicas.append(replica)
+            self._health.append(_ReplicaHealth())
+        if self._draining:
+            replica.begin_drain()
+
+    def remove_replica(self, replica: EngineLoop) -> bool:
+        """Forget a replica (autoscaler scale-down, after its drain). The
+        caller owns draining/joining the loop; in-flight snapshots keep
+        working because breaker objects are identity-stable."""
+        with self._replica_lock:
+            try:
+                i = self.replicas.index(replica)
+            except ValueError:
+                return False
+            if len(self.replicas) == 1:
+                return False  # never empty the pool
+            del self.replicas[i]
+            del self._health[i]
+        return True
 
     # ------------------------------------------------------------- submit
     def submit(self, req: CompletionRequest) -> TokenStream:
@@ -204,7 +247,8 @@ class ReplicaRouter:
             raise DeadlineExceeded(
                 f"request {req.request_id}: deadline_s={req.deadline_s} "
                 "expired before placement")
-        stats = [r.stats() for r in self.replicas]
+        replicas, health = self._snapshot()
+        stats = [r.stats() for r in replicas]
         cap_tokens = max(s.max_request_tokens for s in stats)
         cap_blocks = max(s.max_request_blocks for s in stats)
         if (req.total_tokens > cap_tokens
@@ -219,13 +263,13 @@ class ReplicaRouter:
             # this submit) so plan_placement stays a pure function of stats
             masked = [
                 s if (i not in excluded
-                      and self._health[i].admissible(
+                      and health[i].admissible(
                           now, self.cfg.breaker_reset_s))
                 else replace(s, alive=False)
                 for i, s in enumerate(stats)
             ]
             cached = [r.cached_prefix_tokens(req.prompt)
-                      for r in self.replicas]
+                      for r in replicas]
             idx, verdict = plan_placement(masked, req.total_tokens, self.cfg,
                                           cached_tokens=cached)
             if idx is None:
@@ -233,7 +277,8 @@ class ReplicaRouter:
                     # distinguish "every replica is gone/draining" (503)
                     # from "live replicas exist but are quarantined or just
                     # failed this submit" (429 + come back after the dwell)
-                    if any(s.alive and not s.draining for s in stats):
+                    if any(s.alive and not s.draining
+                           and s.role != "prefill" for s in stats):
                         raise Overloaded(
                             "all live replicas quarantined by the circuit "
                             "breaker", retry_after_s=self.cfg.breaker_reset_s)
@@ -241,10 +286,15 @@ class ReplicaRouter:
                 if tel.enabled:
                     tel.counter("serving_requests_rejected_total").inc()
                 raise Overloaded(
-                    f"all {len(self.replicas)} replicas past "
+                    f"all {len(replicas)} replicas past "
                     f"max_queue_tokens={self.cfg.max_queue_tokens}",
                     retry_after_s=self.cfg.retry_after_s)
-            replica = self.replicas[idx]
+            replica = replicas[idx]
+            # record the placement-time prefix credit on the request so the
+            # engine can re-validate the actual splice at admission (the
+            # probe is advisory — LRU eviction between placement and
+            # admission must cost a cold prefill, not over-credited reuse)
+            req.cached_tokens_hint = cached[idx] if cached else 0
             try:
                 if self._faults.enabled:
                     self._faults.fire(POINT_SUBMIT,
@@ -255,8 +305,8 @@ class ReplicaRouter:
                 stats[idx] = replica.stats()
                 continue
             except Exception as e:  # noqa: BLE001 - breaker feeds on these
-                self._health[idx].note_failure(time.perf_counter(),
-                                               self.cfg.breaker_failures)
+                health[idx].note_failure(time.perf_counter(),
+                                         self.cfg.breaker_failures)
                 if tel.enabled:
                     tel.counter(
                         "serving_submit_failures_total",
@@ -265,7 +315,7 @@ class ReplicaRouter:
                 excluded.add(idx)
                 stats[idx] = replica.stats()
                 continue
-            self._health[idx].note_success()
+            health[idx].note_success()
             self._placements[req.request_id] = replica
             if tel.enabled:
                 tel.counter("serving_requests_admitted_total").inc()
@@ -320,26 +370,28 @@ class ReplicaRouter:
         "degraded" = still serving, but some replica is off its full device
         path (engine ``degraded_mode`` > 0), quarantined by the breaker, or
         dead while others carry the load."""
+        replicas, health = self._snapshot()
         if self._draining or not any(
-                r.stats().alive and not r.draining for r in self.replicas):
+                r.stats().alive and not r.draining for r in replicas):
             return "draining"
-        stats = [r.stats() for r in self.replicas]
+        stats = [r.stats() for r in replicas]
         idx, verdict = plan_placement(stats, 1, self.cfg)
         del idx
         if verdict == "overloaded":
             return "overloaded"
         if (any(s.degraded for s in stats)
                 or any(not s.alive for s in stats)
-                or any(h.breaker != "closed" for h in self._health)):
+                or any(h.breaker != "closed" for h in health)):
             return "degraded"
         return "ready"
 
     def health(self) -> list[dict]:
-        """Per-replica health detail for /healthz: name, state
+        """Per-replica health detail for /healthz: name, role, state
         (healthy | degraded | quarantined | dead), breaker phase, engine
         degradation rung, and containment counters."""
         out = []
-        for r, h in zip(self.replicas, self._health):
+        replicas, health = self._snapshot()
+        for r, h in zip(replicas, health):
             s = r.stats()
             if not s.alive:
                 state = "dead"
@@ -350,7 +402,8 @@ class ReplicaRouter:
             else:
                 state = "healthy"
             out.append({
-                "name": s.name, "state": state, "breaker": h.breaker,
+                "name": s.name, "role": s.role, "state": state,
+                "breaker": h.breaker,
                 "alive": s.alive, "draining": s.draining,
                 "degraded_mode": s.degraded, "crashes": s.crashes,
                 "respawns": s.respawns,
@@ -361,7 +414,7 @@ class ReplicaRouter:
         """Stop admitting everywhere; non-blocking and signal-safe — the
         frontend registers this as an immediate PreemptionHandler hook."""
         self._draining = True
-        for r in self.replicas:
+        for r in self._snapshot()[0]:
             r.begin_drain()
 
     def drain(self, timeout: float | None = None) -> bool:
@@ -369,7 +422,7 @@ class ReplicaRouter:
         work and exit. True if all replicas stopped within the timeout."""
         self.begin_drain()
         ok = True
-        for r in self.replicas:
+        for r in self._snapshot()[0]:
             ok = r.join(timeout) and ok
         return ok
 
@@ -380,8 +433,16 @@ class ReplicaRouter:
         tel = get_telemetry()
         if not tel.enabled:
             return
-        stats = [r.stats() for r in self.replicas]
+        replicas, health = self._snapshot()
+        stats = [r.stats() for r in replicas]
         tel.gauge("serving_replicas").set(len(stats))
+        for role in ("unified", "prefill", "decode"):
+            n = sum(1 for s in stats if s.role == role)
+            if n or role == "unified":
+                tel.gauge(
+                    "serving_replicas_by_role",
+                    "pool size per replica role",
+                ).set(n, role=role)
         tel.gauge("serving_replicas_live").set(
             sum(1 for s in stats if s.alive and not s.draining))
         tel.gauge("serving_queue_depth").set(sum(s.queued for s in stats))
@@ -394,12 +455,12 @@ class ReplicaRouter:
             sum(s.pending_blocks for s in stats))
         tel.gauge("serving_draining").set(1.0 if self._draining else 0.0)
         breaker_rank = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
-        for r, s, h in zip(self.replicas, stats, self._health):
+        for r, s, h in zip(replicas, stats, health):
             tel.gauge(
                 "replica_breaker_state",
                 "0 closed | 1 half-open | 2 open (quarantined)",
-            ).set(breaker_rank[h.breaker], replica=r.name)
+            ).set(breaker_rank[h.breaker], replica=r.name, role=s.role)
             tel.gauge(
                 "replica_degraded_mode",
                 "engine degradation rung (0 full device path)",
-            ).set(float(s.degraded), replica=r.name)
+            ).set(float(s.degraded), replica=r.name, role=s.role)
