@@ -63,10 +63,49 @@ def load_arrays(path: str) -> dict[str, np.ndarray]:
         return {k.replace("\\slash ", "/"): z[k] for k in z.files}
 
 
+def fsync_file(path: str) -> None:
+    """fsync an already-written file by path (durability barrier)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creates inside it are durable. A no-op
+    on filesystems that reject O_RDONLY dir fds (e.g. some network mounts)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Crash-safe text write: temp file in the same directory + fsync +
+    ``os.replace`` + directory fsync. A kill at any instruction leaves either
+    the old content or the new, never a truncated file."""
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(d)
+
+
 def save_json(path: str, obj: dict) -> None:
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(obj, f, indent=2, default=str)
+    """Atomic JSON write (temp + fsync + rename): a crash mid-write can no
+    longer leave a truncated ``manifest.json``/``latest`` behind."""
+    atomic_write_text(path, json.dumps(obj, indent=2, default=str))
 
 
 def load_json(path: str) -> dict:

@@ -89,11 +89,17 @@ class ElasticAgent:
 
     def run(self) -> int:
         """Supervision loop (reference ``_invoke_run:127``): launch at the
-        largest admissible world size; on any worker death, stop the rest and
-        relaunch at the largest size admissible with one fewer worker slot.
-        Returns 0 when all workers exit cleanly."""
+        largest admissible world size; on any worker death — a nonzero exit,
+        a SIGKILL'd preemption (negative returncode), a crashed host — stop
+        the rest and relaunch at the largest size admissible with one fewer
+        worker slot. The relaunched workers resume from the newest verified
+        checkpoint (``load_checkpoint`` walks the fallback ladder, so even a
+        worker killed mid-checkpoint-commit restarts clean). Returns 0 when
+        all workers exit cleanly. ``self.restarts`` / ``self.world_size``
+        record what supervision did, for harness assertions."""
         world = self.admissible_world_sizes()[-1]
-        restarts = 0
+        self.restarts = 0
+        self.world_size = world
         self._launch(world)
         while True:
             time.sleep(self.poll_interval)
@@ -115,8 +121,8 @@ class ElasticAgent:
                         w.proc.wait(timeout=30)
                     except subprocess.TimeoutExpired:
                         w.proc.kill()
-                restarts += 1
-                if restarts > self.max_restarts:
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
                     log_dist("elastic agent: restart budget exhausted", ranks=[0])
                     return 1
                 # scale down: CAPACITY shrinks by the dead workers (spare
@@ -131,6 +137,7 @@ class ElasticAgent:
                     return 1
                 if self.on_scale_change is not None:
                     self.on_scale_change(world)
+                self.world_size = world
                 self._launch(world)
 
 
@@ -182,10 +189,15 @@ class PreemptionHandler:
         return fn
 
     def _checkpoint(self):
+        """Write the one preempt checkpoint and JOIN any async flush before
+        returning: the process is about to exit, and a writer-thread error
+        surfaced here is the last chance to see it (a silently dropped flush
+        error would leave ``latest`` pointing at the previous checkpoint
+        while the operator believes the preempt save landed)."""
         path = self.engine.save_checkpoint(self.save_dir, tag="preempt")
         join = getattr(self.engine, "_join_ckpt_writer", None)
         if join is not None:
-            join()
+            join()  # raises if the async flush failed; do not swallow
         return path
 
     def _on_signal(self, signum, frame):
@@ -199,8 +211,9 @@ class PreemptionHandler:
                 self._ran[name] = None
                 try:
                     self._ran[name] = fn()
-                except Exception:  # a failing hook must not mask the signal
-                    log_dist(f"preemption hook {name!r} failed", ranks=[0])
+                except Exception as e:  # a failing hook must not mask the signal
+                    log_dist(f"preemption hook {name!r} failed: {e!r}",
+                             ranks=[0])
 
     def _run_once(self, name: str, fn: Callable[[], object]):
         if name not in self._ran:

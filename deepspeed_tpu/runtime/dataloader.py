@@ -15,21 +15,89 @@ import numpy as np
 
 
 class RepeatingLoader:
-    """Wrap an iterable so it restarts on StopIteration (reference ``RepeatingLoader:17``)."""
+    """Wrap an iterable so it restarts on StopIteration (reference ``RepeatingLoader:17``).
+
+    Carries checkpointable position state: ``state_dict()`` records
+    ``(epoch, batches_into_epoch)`` and ``load_state_dict()`` replays the
+    wrapped iterable to that exact point, so a resumed run pulls the same
+    batch sequence the interrupted run would have (exact-resume contract;
+    requires the wrapped iterable to be deterministically re-iterable)."""
 
     def __init__(self, loader):
         self.loader = loader
         self._iter = iter(loader)
+        self._epoch = 0
+        self._pos = 0
 
     def __iter__(self):
         return self
 
     def __next__(self):
         try:
-            return next(self._iter)
+            item = next(self._iter)
         except StopIteration:
             self._iter = iter(self.loader)
-            return next(self._iter)
+            self._epoch += 1
+            self._pos = 0
+            item = next(self._iter)
+        self._pos += 1
+        return item
+
+    def state_dict(self) -> dict:
+        return {"epoch": self._epoch, "pos": self._pos}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._epoch = 0
+        self._pos = 0
+        self._iter = iter(self.loader)
+        target = int(state.get("epoch", 0)) or 0
+        while self._epoch < target:
+            try:
+                next(self._iter)
+            except StopIteration:
+                self._iter = iter(self.loader)
+                self._epoch += 1
+        for _ in range(int(state.get("pos", 0))):
+            next(self)
+        # the skip above may have crossed an epoch boundary bookkeeping-wise;
+        # pin the recorded position to the target
+        self._epoch = target
+        self._pos = int(state.get("pos", 0))
+
+
+class CheckpointableLoader:
+    """Make any iterator factory exactly resumable by counting batches.
+
+    ``factory(skip)`` must return an iterator positioned after ``skip``
+    batches of the deterministic stream (for seeded generators that is
+    usually "rebuild and fast-forward"; for indexable datasets it can seek).
+    ``state_dict()``/``load_state_dict()`` round-trip through the engine's
+    checkpoint manifest, so ``load_checkpoint`` restores the data-iterator
+    position along with the model (docs/FAULT_TOLERANCE.md, exact resume)."""
+
+    def __init__(self, factory, batches_consumed: int = 0):
+        self._factory = factory
+        self._consumed = int(batches_consumed)
+        self._iter = factory(self._consumed)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = next(self._iter)
+        self._consumed += 1
+        return item
+
+    @property
+    def batches_consumed(self) -> int:
+        return self._consumed
+
+    def state_dict(self) -> dict:
+        return {"batches_consumed": self._consumed}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._consumed = int(state.get("batches_consumed", 0))
+        self._iter = self._factory(self._consumed)
 
 
 def array_loader(

@@ -1934,6 +1934,28 @@ class Engine:
         tel.sample_memory(step=step)
 
     # ------------------------------------------------------------------ checkpoint
+    def _rng_state_dict(self) -> dict:
+        """Host-serializable snapshot of the engine's RNG streams so a resume
+        replays the identical trajectory (``_rng`` feeds eval/forward draws;
+        ``_train_rng`` is folded by step inside the jitted step but is saved
+        for completeness)."""
+        def key_bits(k):
+            try:
+                return np.asarray(k)
+            except TypeError:  # typed PRNG key arrays
+                return np.asarray(jax.random.key_data(k))
+        return {"_rng": key_bits(self._rng).tolist(),
+                "_train_rng": key_bits(self._train_rng).tolist()}
+
+    def _load_rng_state(self, state: dict | None) -> None:
+        if not state:
+            return
+        if "_rng" in state:
+            self._rng = jnp.asarray(np.asarray(state["_rng"], np.uint32))
+        if "_train_rng" in state:
+            self._train_rng = jnp.asarray(
+                np.asarray(state["_train_rng"], np.uint32))
+
     def save_checkpoint(self, save_dir: str, tag: str | None = None,
                         client_state: dict | None = None, save_latest: bool = True):
         """Reference ``engine.py:4557 save_checkpoint``: tagged dir + manifest +
@@ -1943,17 +1965,24 @@ class Engine:
         reference's per-rank ``zero_pp_rank_*`` files, in universal-fragment
         form (``ds_to_universal.py``) so any mesh can load them. With
         ``checkpoint.async_save`` the host snapshot happens here (the double
-        buffer) and the disk flush runs on a writer thread."""
+        buffer) and the disk flush runs on a writer thread.
+
+        Crash safety is a two-phase commit (checkpoint/engine.py): all files
+        land in ``{save_dir}/.tmp-{tag}/``, get fsynced and checksummed into
+        the manifest, and one ``os.replace`` promotes the directory before
+        the ``latest`` pointer moves — a kill at any instruction leaves the
+        previous checkpoint intact and loadable."""
         import os
         import threading
 
         from deepspeed_tpu.checkpoint import engine as ckpt
         from deepspeed_tpu.checkpoint import sharded
-        from deepspeed_tpu.checkpoint import serialization as ser
+        from deepspeed_tpu.serving import faults as _faults
 
+        inj = _faults.get_fault_injector()
         ckpt_t0 = time.perf_counter()
         tag = tag or f"global_step{self.global_steps}"
-        ckpt_dir = os.path.join(save_dir, str(tag))
+        stage_dir = ckpt.staging_dir(save_dir, str(tag))
         manifest = {
             "tag": tag,
             "framework_version": __import__("deepspeed_tpu").__version__,
@@ -1966,12 +1995,17 @@ class Engine:
             "loss_scale": float(self.scale_state.scale),
             "scale_state": {k: float(v) for k, v in self.scale_state._asdict().items()},
             "lr_scheduler": self.lr_scheduler.state_dict(),
+            "rng_state": self._rng_state_dict(),
+            "dataloader_state": (
+                self.training_dataloader.state_dict()
+                if hasattr(self.training_dataloader, "state_dict") else None),
             "world_size": self.topo.world_size,
             "mesh": dict(self.topo.sizes),
             "config": self.config.to_dict(),
             "client_state": client_state or {},
         }
         # snapshot to host now (double buffer); flush sync or on writer thread
+        inj.fire(_faults.POINT_CKPT_COLLECT)
         model_payload = sharded.collect_fragments(self.params, "model")
         if self._offload_mode == "nvme":
             # state lives on disk between steps; stream it GROUP BY GROUP into
@@ -1981,14 +2015,14 @@ class Engine:
             # point the loader at the right group file)
             import jax as _jax
 
-            os.makedirs(ckpt_dir, exist_ok=True)
+            os.makedirs(stage_dir, exist_ok=True)
             index: dict = {}
             for g, t in enumerate(self._nvme_templates):
                 state = self._swapper.swap_in_tree(f"opt_g{g}", t)
                 p, ix = sharded.collect_fragments(
                     [None] * g + [state], f"optimizer_g{g}")
                 np.savez(os.path.join(
-                    ckpt_dir,
+                    stage_dir,
                     f"optimizer_g{g}_shard_p{_jax.process_index()}.npz"), **p)
                 index.update(ix)
                 del state, p
@@ -1999,18 +2033,26 @@ class Engine:
         def flush():
             import jax as _jax
 
-            sharded.write_fragments(ckpt_dir, "model", *model_payload)
-            sharded.write_fragments(ckpt_dir, "optimizer", *opt_payload)
-            if _jax.process_index() == 0:
-                ser.save_json(os.path.join(ckpt_dir, "manifest.json"), manifest)
+            # phase 1 (prepare): everything goes into the staging dir
+            inj.fire(_faults.POINT_CKPT_FLUSH)
+            sharded.write_fragments(stage_dir, "model", *model_payload)
+            inj.fire(_faults.POINT_CKPT_FLUSH, path=os.path.join(
+                stage_dir, f"model_shard_p{_jax.process_index()}.npz"))
+            sharded.write_fragments(stage_dir, "optimizer", *opt_payload)
+            inj.fire(_faults.POINT_CKPT_FLUSH, path=os.path.join(
+                stage_dir, f"optimizer_shard_p{_jax.process_index()}.npz"))
             dist.barrier("save_checkpoint")
             if _jax.process_index() == 0:
-                sharded.finalize_index(ckpt_dir, "model")
-                sharded.finalize_index(ckpt_dir, "optimizer")
+                sharded.finalize_index(stage_dir, "model")
+                sharded.finalize_index(stage_dir, "optimizer")
+                # phase 2 (commit): checksum + manifest + atomic promote
+                ckpt_dir = ckpt.commit_checkpoint(save_dir, str(tag), manifest)
                 if save_latest:
                     ckpt.write_latest(save_dir, str(tag))
-                ckpt.rotate_checkpoints(save_dir, self.config.checkpoint.keep_n_latest)
-            log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+                ckpt.rotate_checkpoints(
+                    save_dir, self.config.checkpoint.keep_n_latest,
+                    protect=str(tag))
+                log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
 
         self._join_ckpt_writer()
         import jax as _jax
@@ -2045,7 +2087,7 @@ class Engine:
                 "checkpoint_saves_total", "checkpoints written").inc()
             if self.stepscope.enabled:
                 self.stepscope.note_overhead("checkpoint", dur)
-        return ckpt_dir
+        return os.path.join(save_dir, str(tag))
 
     def _join_ckpt_writer(self):
         """Wait for an in-flight async checkpoint flush; raises its error."""
@@ -2058,27 +2100,116 @@ class Engine:
             self._ckpt_writer_error = None
             raise RuntimeError("async checkpoint flush failed") from err
 
+    def _resolve_verified_checkpoint(self, load_dir: str, tag: str | None,
+                                     verify: bool = True):
+        """Pick the checkpoint to load: the requested/``latest`` tag if it
+        verifies, else walk the fallback ladder — every other committed tag,
+        newest first by the step parsed from the tag — to the newest one
+        that does. Returns ``(tag, ckpt_dir, manifest)``; ``(None, None,
+        None)`` when the directory holds no checkpoints at all; raises
+        :class:`~deepspeed_tpu.checkpoint.engine.CheckpointCorruptError`
+        (stage=``exhausted``) when candidates exist but none survives
+        verification."""
+        import os
+
+        from deepspeed_tpu.checkpoint import engine as ckpt
+        from deepspeed_tpu.checkpoint import serialization as ser
+
+        requested = tag or ckpt.latest_tag(load_dir)
+        candidates = list(ckpt.list_tags(load_dir))
+        if requested is not None and requested not in candidates:
+            candidates.insert(0, requested)
+        elif requested is not None:
+            candidates.remove(requested)
+            candidates.insert(0, requested)
+        if not candidates:
+            return None, None, None
+        from deepspeed_tpu.serving import faults as _faults
+
+        inj = _faults.get_fault_injector()
+        tel = self.telemetry
+        fallbacks = 0
+        for cand in candidates:
+            cdir = os.path.join(load_dir, str(cand))
+            if inj.enabled and os.path.isdir(cdir):
+                # hand the file-mutating fault kinds (truncate/corrupt-bytes)
+                # the candidate's biggest payload file: bit-rot discovered at
+                # read time, which verification must catch and ladder past
+                files = [os.path.join(cdir, f) for f in os.listdir(cdir)
+                         if f != "manifest.json"]
+                files = [f for f in files if os.path.isfile(f)]
+                if files:
+                    inj.fire(_faults.POINT_CKPT_LOAD,
+                             path=max(files, key=os.path.getsize))
+            v0 = time.perf_counter()
+            try:
+                if verify:
+                    manifest = ckpt.verify_checkpoint(cdir)
+                else:
+                    manifest = ser.load_json(
+                        os.path.join(cdir, ckpt.MANIFEST))
+            except (ckpt.CheckpointCorruptError, OSError, ValueError) as e:
+                stage = getattr(e, "stage", "manifest-unreadable")
+                log_dist(
+                    f"checkpoint {cand} failed verification "
+                    f"({stage}): {e}; walking back", ranks=[0])
+                if tel.enabled:
+                    tel.counter(
+                        "checkpoint_corrupt_total",
+                        "checkpoint integrity failures, by verification "
+                        "stage").inc(stage=stage)
+                fallbacks += 1
+                continue
+            finally:
+                if tel.enabled:
+                    tel.histogram(
+                        "checkpoint_verify_seconds",
+                        "wall clock of checkpoint verification").observe(
+                            time.perf_counter() - v0)
+            if fallbacks and tel.enabled:
+                tel.counter(
+                    "checkpoint_fallback_total",
+                    "loads that fell back past a corrupt checkpoint",
+                ).inc(fallbacks)
+            return str(cand), cdir, manifest
+        if tel.enabled:
+            tel.counter(
+                "checkpoint_corrupt_total",
+                "checkpoint integrity failures, by verification stage",
+            ).inc(stage="exhausted")
+        raise ckpt.CheckpointCorruptError(
+            f"no verifiable checkpoint under {load_dir} "
+            f"(tried {len(candidates)}: {candidates[:8]})",
+            stage="exhausted", tag=str(requested or ""))
+
     def load_checkpoint(self, load_dir: str, tag: str | None = None,
                         load_optimizer_states: bool = True,
-                        load_lr_scheduler_states: bool = True):
+                        load_lr_scheduler_states: bool = True,
+                        verify: bool = True):
         """Reference ``engine.py:4079 load_checkpoint``. Arrays are re-placed
         under the *current* sharding plan, so loading across a different mesh /
-        ZeRO stage / world size is automatic (UCP semantics)."""
+        ZeRO stage / world size is automatic (UCP semantics).
+
+        Every candidate is checksum-verified (commit marker, per-file
+        sha256, fragment coverage) BEFORE any engine state is touched; on
+        corruption the loader walks the tag ladder back to the newest
+        verifiable checkpoint and only raises when none survives."""
         import os
 
         from deepspeed_tpu.checkpoint import engine as ckpt
         from deepspeed_tpu.checkpoint import serialization as ser
 
         from deepspeed_tpu.checkpoint import sharded
+        from deepspeed_tpu.serving import faults as _faults
 
         ckpt_t0 = time.perf_counter()
         self._join_ckpt_writer()
-        tag = tag or ckpt.latest_tag(load_dir)
+        _faults.get_fault_injector().fire(_faults.POINT_CKPT_LOAD)
+        tag, ckpt_dir, manifest = self._resolve_verified_checkpoint(
+            load_dir, tag, verify=verify)
         if tag is None:
             log_dist(f"no checkpoint found under {load_dir}", ranks=[0])
             return None, {}
-        ckpt_dir = os.path.join(load_dir, str(tag))
-        manifest = ser.load_json(os.path.join(ckpt_dir, "manifest.json"))
 
         if sharded.is_sharded(ckpt_dir, "model"):
             # assemble only this process's target shards from the fragments
@@ -2151,6 +2282,13 @@ class Engine:
         self.skipped_steps = int(manifest["skipped_steps"])
         if load_lr_scheduler_states:
             self.lr_scheduler.load_state_dict(manifest["lr_scheduler"])
+        # exact resume: restore the host RNG streams and the data-iterator
+        # position so the resumed run replays the identical loss trajectory
+        self._load_rng_state(manifest.get("rng_state"))
+        dl_state = manifest.get("dataloader_state")
+        if dl_state is not None and hasattr(self.training_dataloader,
+                                            "load_state_dict"):
+            self.training_dataloader.load_state_dict(dl_state)
         if self._zenflow:
             self._zf_reset_transients()
         log_dist(

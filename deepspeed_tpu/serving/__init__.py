@@ -56,6 +56,11 @@ from deepspeed_tpu.serving.protocol import (  # noqa: F401
 )
 from deepspeed_tpu.serving.faults import (  # noqa: F401
     POINT_ALLOC,
+    POINT_CKPT_COLLECT,
+    POINT_CKPT_COMMIT,
+    POINT_CKPT_FLUSH,
+    POINT_CKPT_LATEST,
+    POINT_CKPT_LOAD,
     POINT_DISPATCH,
     POINT_H2D,
     POINT_LOOP,

@@ -1,22 +1,32 @@
-"""Deterministic fault injection for the serving + inference path.
+"""Deterministic fault injection for the serving, inference, and training
+checkpoint paths.
 
 A process-local :class:`FaultInjector` singleton exposes **named injection
 points** at the real seams of the stack — device dispatch, H2D upload,
-token readback, block allocation, the engine-loop iteration, and the
-router→replica submit edge. Production code calls ``fire(point)`` at each
-seam; with no faults armed this is a single attribute check and the hot
-paths pay nothing. Tests, ``bench.py --mode chaos``, and CI arm a
-*schedule* of :class:`FaultSpec` entries, each of which fires
-deterministically by hit count (``after`` / ``every`` / ``times``) or per
-request (``request_id``), so a failing run replays exactly.
+token readback, block allocation, the engine-loop iteration, the
+router→replica submit edge, and the training checkpoint pipeline
+(collect / flush / commit / latest-update / load). Production code calls
+``fire(point)`` at each seam; with no faults armed this is a single
+attribute check and the hot paths pay nothing. Tests, ``bench.py --mode
+chaos`` / ``--mode train-chaos``, and CI arm a *schedule* of
+:class:`FaultSpec` entries, each of which fires deterministically by hit
+count (``after`` / ``every`` / ``times``) or per request (``request_id``),
+so a failing run replays exactly.
 
-Three fault kinds:
+Fault kinds:
 
 - ``raise`` — raise :class:`FaultError` (transient) or
   :class:`FatalFaultError` (``fatal=True``) at the seam.
 - ``hang`` — sleep ``delay_s`` then raise ``TimeoutError`` (models a wedged
   transfer surfacing as a deadline).
 - ``latency`` — sleep ``delay_s`` and continue (slow path, no error).
+- ``truncate`` — cut the file the seam passed via ``fire(path=)`` to half
+  its size and continue (models a torn write the writer never noticed).
+- ``corrupt-bytes`` — flip one seeded byte of that file and continue
+  (models silent on-disk corruption; checksum verification must catch it).
+- ``kill`` — ``SIGKILL`` the calling process at the seam (the train-chaos
+  harness's mid-flush / mid-commit kills; nothing downstream of the seam
+  runs, exactly like a preemption landing there).
 
 ``classify_transient`` is the shared error taxonomy used by the dispatch
 watchdog (inference/ragged.py) and the router breaker: injected transient
@@ -26,7 +36,9 @@ everything else is fatal and escalates. See docs/FAULT_TOLERANCE.md.
 
 from __future__ import annotations
 
+import os
 import random
+import signal
 import threading
 import time
 from dataclasses import dataclass, field
@@ -41,6 +53,15 @@ POINT_ALLOC = "engine.alloc"         # KV block allocation
 POINT_LOOP = "loop.step"             # engine-loop thread, once per busy tick
 POINT_SUBMIT = "router.submit"       # router→replica submit edge
 
+# Training checkpoint seams (runtime/engine.py save/load + checkpoint/engine.py
+# commit protocol). The file-mutating kinds (truncate / corrupt-bytes) act on
+# the path each seam passes via ``fire(path=)``.
+POINT_CKPT_COLLECT = "ckpt.collect"  # device→host shard snapshot
+POINT_CKPT_FLUSH = "ckpt.flush"      # fragment/index writes into staging
+POINT_CKPT_COMMIT = "ckpt.commit"    # manifest sealed, before dir promote
+POINT_CKPT_LATEST = "ckpt.latest"    # latest-pointer update
+POINT_CKPT_LOAD = "ckpt.load"        # load/verify entry
+
 POINTS = (
     POINT_DISPATCH,
     POINT_H2D,
@@ -48,6 +69,11 @@ POINTS = (
     POINT_ALLOC,
     POINT_LOOP,
     POINT_SUBMIT,
+    POINT_CKPT_COLLECT,
+    POINT_CKPT_FLUSH,
+    POINT_CKPT_COMMIT,
+    POINT_CKPT_LATEST,
+    POINT_CKPT_LOAD,
 )
 
 
@@ -92,7 +118,8 @@ class FaultSpec:
         if self.point not in POINTS:
             raise ValueError(
                 f"unknown fault point {self.point!r} (known: {POINTS})")
-        if self.kind not in ("raise", "hang", "latency"):
+        if self.kind not in ("raise", "hang", "latency", "truncate",
+                             "corrupt-bytes", "kill"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
 
 
@@ -142,9 +169,11 @@ class FaultInjector:
             self.enabled = False
 
     # ------------------------------------------------------------- firing
-    def fire(self, point: str, request_id: str | None = None) -> None:
+    def fire(self, point: str, request_id: str | None = None,
+             path: str | None = None) -> None:
         """Called by production code at the named seam. No-op unless a
-        matching armed spec elects this hit."""
+        matching armed spec elects this hit. ``path`` names the file the
+        seam just touched, for the file-mutating kinds."""
         if not self.enabled:
             return
         spec = None
@@ -154,6 +183,8 @@ class FaultInjector:
                     continue
                 if s.request_id is not None and s.request_id != request_id:
                     continue
+                if s.kind in ("truncate", "corrupt-bytes") and path is None:
+                    continue  # file kinds only elect hits that carry a path
                 s.hits += 1
                 if s.times and s.fired >= s.times:
                     continue
@@ -179,6 +210,28 @@ class FaultInjector:
             f" (hit {spec.hits}, firing {spec.fired})")
         if spec.kind == "latency":
             time.sleep(spec.delay_s)
+            return
+        if spec.kind == "kill":
+            # a preemption landing exactly at this seam: no cleanup, no
+            # flush, no atexit — the process is simply gone
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(60)  # pragma: no cover - death is asynchronous
+            return
+        if spec.kind == "truncate":
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(size // 2)
+            return
+        if spec.kind == "corrupt-bytes":
+            size = os.path.getsize(path)
+            if size:
+                with self._lock:
+                    off = self._rng.randrange(size)
+                with open(path, "r+b") as f:
+                    f.seek(off)
+                    orig = f.read(1)
+                    f.seek(off)
+                    f.write(bytes([(orig[0] ^ 0xFF) if orig else 0xFF]))
             return
         if spec.kind == "hang":
             time.sleep(spec.delay_s)

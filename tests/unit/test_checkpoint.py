@@ -76,12 +76,51 @@ def test_resume_training_matches_continuous(tmp_path):
 
     resumed = _new_engine(1, {"data": 1, "fsdp": 8})
     resumed.load_checkpoint(str(tmp_path), tag="mid")
-    # restore the data-independent rng stream position
-    resumed._rng = half._rng
+    # exact resume: the manifest carries the rng stream state — no manual
+    # rng surgery, the resumed engine replays the continuous trajectory
+    assert np.array_equal(np.asarray(resumed._rng), np.asarray(half._rng))
     for b in batches[2:]:
         resumed.train_batch(b)
     for a, b in zip(cont_params, jax.tree_util.tree_leaves(resumed.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)
+
+
+def test_exact_resume_restores_dataloader_position(tmp_path):
+    """The manifest carries the data-iterator position: a resumed run pulls
+    the SAME next batch the interrupted run would have — loss trajectories
+    are step-identical without any caller-side data bookkeeping."""
+    from deepspeed_tpu.runtime.dataloader import CheckpointableLoader
+
+    def factory(skip):
+        def gen():
+            i = skip
+            while True:
+                rng = np.random.default_rng(100 + i)
+                yield {"input_ids": rng.integers(0, VOCAB, (16, 16),
+                                                 dtype=np.int32)}
+                i += 1
+        return gen()
+
+    def new_engine():
+        reset_topology()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=_builder(), config=_config(0, {"data": 8}),
+            training_data=CheckpointableLoader(factory), seed=11)
+        return engine
+
+    cont = new_engine()
+    cont_losses = [float(cont.train_batch()) for _ in range(4)]
+
+    half = new_engine()
+    for _ in range(2):
+        half.train_batch()
+    half.save_checkpoint(str(tmp_path))
+
+    resumed = new_engine()
+    resumed.load_checkpoint(str(tmp_path))
+    assert resumed.training_dataloader.batches_consumed == 2
+    tail = [float(resumed.train_batch()) for _ in range(2)]
+    np.testing.assert_allclose(tail, cont_losses[2:], rtol=2e-5)
 
 
 def test_reshard_across_zero_stage_and_mesh(tmp_path):
