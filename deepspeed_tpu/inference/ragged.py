@@ -213,6 +213,28 @@ class RaggedConfig:
     # False restores the legacy host-staged dispatch path (token-identical;
     # kept as the parity baseline and an escape hatch).
     device_state: bool = True
+    # device-side multi-step decode scheduler (>= 2 enables): when every
+    # running sequence is decoding, ONE jitted program runs up to
+    # sched_steps decode steps and retires slots on EOS/length INSIDE the
+    # program (a lax.while_loop that masks retired rows to the scratch
+    # slot and early-exits when all rows retire), returning per-slot
+    # steps_taken so the host only reconciles — no per-token dispatch and
+    # no post-EOS wasted compute. Requires device_state (silently inert
+    # under the host-staged kill switch, which stays token-identical).
+    sched_steps: int = 0
+    # self-speculative decoding depth (> 0 enables; requires
+    # sched_steps >= 2): each scheduler iteration proposes up to
+    # spec_draft tokens per slot from a device-resident n-gram /
+    # prompt-lookup draft (suffix match over the slot's own token
+    # history — no second model), verifies all of them in ONE batched
+    # forward, and surfaces the accepted prefix plus the target's bonus
+    # pick. Verification is exact-match against the target's own
+    # deterministic picks, so output is BIT-identical to plain decoding
+    # for greedy AND seeded sampling (per_request_keys makes each draw a
+    # function of (seed, gen_idx) only).
+    spec_draft: int = 0
+    # suffix-match length for the prompt-lookup draft source
+    spec_ngram: int = 3
     # ---- dispatch watchdog (docs/FAULT_TOLERANCE.md) ----
     # wall-clock budget for one step(); a step exceeding it counts toward
     # the degradation ladder like a transient failure (the device path is
@@ -527,6 +549,27 @@ class RaggedInferenceEngine:
         self._dev_step_jits: dict = {}
         self._dev_chunk_jits: dict = {}
         self._dev_fused_jits: dict = {}
+        # device-side multi-step scheduler (cfg.sched_steps) + self-
+        # speculative decoding (cfg.spec_draft) program cache
+        self._dev_sched_jits: dict = {}
+        # self-speculative draft state: per-slot token-history rows (prompt +
+        # generated, by context position) the n-gram draft suffix-matches on
+        # device. The scheduler program appends what it emits; any OTHER
+        # path that moves a slot (admission, handoff import, recovery,
+        # non-sched dispatches) flips the host-side stale flag so the row is
+        # re-uploaded from prompt+generated before the slot's next sched
+        # dispatch.
+        self._hist_dev = (jnp.zeros((s1, self.cfg.max_seq_len), jnp.int32)
+                          if self.cfg.spec_draft else None)
+        self._hist_stale = np.ones(s1, bool)
+        self._hist_row_jit = jax.jit(
+            lambda h, row, vals: h.at[row].set(vals), donate_argnums=(0,))
+        # set when a sched dispatch declined because stale history rows
+        # cannot sync yet (outstanding refs): the turn loop must reconcile
+        # instead of falling through to per-step dispatch
+        self._sched_wait = False
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         # dispatch-overhead accounting (plain ints so the bench reads them
         # with telemetry off; telemetry mirrors them when enabled)
         self.host_stage_ns = 0
@@ -562,6 +605,13 @@ class RaggedInferenceEngine:
             raise ValueError("fused_chunk must be 0 (off) or >= 2")
         if self.cfg.fused_chunk and self.cfg.pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
+        if self.cfg.sched_steps == 1 or self.cfg.sched_steps < 0:
+            raise ValueError("sched_steps must be 0 (off) or >= 2")
+        if self.cfg.spec_draft:
+            if self.cfg.sched_steps < 2:
+                raise ValueError("spec_draft requires sched_steps >= 2")
+            if self.cfg.spec_ngram < 1:
+                raise ValueError("spec_ngram must be >= 1")
         # scheduling efficiency telemetry (padding fraction; comparable to the
         # dense engine's pad-to-max waste) + dispatch accounting (on a
         # high-RTT transport, dispatches per token is the serving cost)
@@ -1013,6 +1063,10 @@ class RaggedInferenceEngine:
             self.h2d_bytes += iv.nbytes + fv.nbytes + 4
             self._dev_state = self._slot_row_jit(
                 self._dev_state, np.int32(slot), iv, fv)
+        # draft history is NOT part of the handoff row format: the decode
+        # side rebuilds it from prompt + generated before the slot's first
+        # speculative dispatch
+        self._hist_stale[slot] = True
         return True
 
     def export_prefix(self, prompt_tokens) -> PrefixPayload | None:
@@ -1267,6 +1321,7 @@ class RaggedInferenceEngine:
         self.h2d_bytes += iv.nbytes + fv.nbytes + 4
         self._dev_state = self._slot_row_jit(
             self._dev_state, np.int32(seq.slot), iv, fv)
+        self._hist_stale[seq.slot] = True
 
     def _sync_bt(self) -> None:
         """Delta-upload block-table rows dirtied since the last dispatch
@@ -1528,6 +1583,7 @@ class RaggedInferenceEngine:
             s.pos += k
             s.refs += 1
             self._slot_feed[s.slot] = True
+            self._hist_stale[s.slot] = True
             emits.append((s, k))
         self.tokens_scheduled += k * t
         self.tokens_padded += k * (bucket - t)
@@ -1538,6 +1594,280 @@ class RaggedInferenceEngine:
             self._trace_spans(t0, time.perf_counter(),
                               [(s, "engine/decode", k) for s in seqs],
                               mode="dev_run_ahead")
+        return True
+
+    # ------------------------------------- device-side multi-step scheduler
+    def _get_dev_sched(self, k: int, t: int, w: int, sampled: bool,
+                       has_tk: bool, has_tp: bool):
+        """Multi-step decode scheduler with DEVICE-SIDE retirement (+
+        optional self-speculation): a ``lax.while_loop`` over up to ``k``
+        decode iterations that retires rows on EOS/length inside the
+        program — retired rows mask to the scratch slot, the loop exits
+        early once every row is done — and returns per-row ``steps_taken``
+        so the host only reconciles.
+
+        The staging buffer is ``[slots | eos | limit]`` (``limit`` = last
+        feed position, ``prompt_len + max_new - 1``, constant per request),
+        so steady decode byte-compares equal and uploads NOTHING; feed
+        token and position come from the persistent slot rows, and per-row
+        step budgets are derived on device as ``limit - pos``. Rows the
+        host believes live but the device already retired (pipelined
+        dispatch after an EOS pick) re-derive ``done`` from their
+        persistent token row, emit zero steps, and cost no compute.
+
+        With ``cfg.spec_draft`` > 0 each iteration proposes up to D tokens
+        per row from the device-resident history (prompt lookup), verifies
+        them in the SAME forward via ``speculative_lane_layout``, and
+        surfaces the exact-match acceptance prefix + the target's bonus
+        pick — emitting up to D+1 tokens per iteration while staying
+        bit-identical to plain decoding (greedy and seeded)."""
+        d = self.cfg.spec_draft
+        key = (k, t, w, sampled, has_tk, has_tp)
+        fn = self._dev_sched_jits.get(key)
+        self._note_program("dev_sched", fn is None)
+        if fn is not None:
+            return fn
+        fwd = self.spec.ragged_forward_fn
+        max_seqs = self.cfg.max_seqs
+        ngram = self.cfg.spec_ngram
+        lanes = 1 + d
+
+        def sched_body(params, cache, state, hist, bt_full, staged, root):
+            from deepspeed_tpu.inference.sampling import (
+                accept_drafts, keys_for_positions, propose_ngram_drafts,
+                sample_tokens)
+            from deepspeed_tpu.models.paged import speculative_lane_layout
+            tok_st, pos_st, seed_st, plen_st, temp_st, topk_st, topp_st = state
+            slots = staged[:t]
+            eos = staged[t:2 * t]
+            limit = staged[2 * t:3 * t]
+            real = slots != max_seqs
+            bt = bt_full[:, :w] if w < bt_full.shape[1] else bt_full
+            toks0 = tok_st[slots]
+            pos0 = jnp.where(real, pos_st[slots], 0)
+            seeds = seed_st[slots]
+            plen = plen_st[slots]
+            temp = temp_st[slots]
+            topk = topk_st[slots]
+            topp = topp_st[slots]
+            # per-row step budget; the host guaranteed KV capacity for
+            # exactly min(k, limit - pos) feeds, so cap marks the first
+            # position WITHOUT an allocated block
+            bud = jnp.where(real, jnp.clip(limit - pos0, 0, k), 0)
+            cap = pos0 + bud
+            # device-side retirement of rows the host optimistically
+            # re-dispatched: the persistent token row already holds EOS
+            done0 = ~real | (bud <= 0) | ((eos >= 0) & (toks0 == eos))
+
+            def rep(x):  # row value -> per-verify-lane (row-major lanes)
+                return jnp.repeat(x, lanes)
+
+            def pick_lanes(lg, fpos_raw):
+                if not sampled:
+                    return jnp.argmax(lg.astype(jnp.float32),
+                                      axis=-1).astype(jnp.int32)
+                keys = keys_for_positions(root, rep(seeds), fpos_raw,
+                                          rep(plen))
+                return sample_tokens(lg, keys, rep(temp),
+                                     top_k=rep(topk) if has_tk else None,
+                                     top_p=rep(topp) if has_tp else None)[0]
+
+            lane_i = jnp.arange(lanes)[None, :]
+            col_i = jnp.broadcast_to(jnp.arange(t)[:, None], (t, lanes))
+
+            def body(c):
+                if d:
+                    cache, toks, pos, emitted, done, out, prop, acc, hist = c
+                else:
+                    cache, toks, pos, emitted, done, out, prop, acc = c
+                    hist = None
+                live = ~done
+                if d:
+                    draft, _ = propose_ngram_drafts(hist[slots], pos, ngram,
+                                                    d)
+                else:
+                    draft = None
+                ftok, fslot, fpos, fraw = speculative_lane_layout(
+                    toks, draft, pos, live, cap, slots, max_seqs)
+                lg, cache = fwd(params, ftok, fslot, fpos, bt, cache)
+                picked = pick_lanes(lg, fraw).reshape(t, lanes)
+                n_emit, n_acc = accept_drafts(
+                    draft if d else jnp.zeros((t, 0), jnp.int32), picked,
+                    jnp.where(live, bud - emitted, 0), eos)
+                sel = lane_i < n_emit[:, None]
+                # surfaced tokens land at out rows emitted..emitted+n-1;
+                # unselected lanes scatter into dump row k
+                tgt = jnp.where(sel, emitted[:, None] + lane_i, k)
+                out = out.at[tgt, col_i].set(picked)
+                if d:
+                    # emitted token i is the token at context position
+                    # pos+1+i: append to the history the draft reads
+                    hpos = jnp.where(sel, pos[:, None] + 1 + lane_i, 0)
+                    hslot = jnp.where(sel, slots[:, None], max_seqs)
+                    hist = hist.at[hslot, hpos].set(picked)
+                last = jnp.take_along_axis(
+                    picked, jnp.clip(n_emit - 1, 0, lanes - 1)[:, None],
+                    axis=1)[:, 0]
+                toks = jnp.where(n_emit > 0, last, toks)
+                pos = pos + n_emit
+                emitted = emitted + n_emit
+                hit_eos = (eos >= 0) & (last == eos) & (n_emit > 0)
+                done = done | hit_eos | (emitted >= bud)
+                if d:
+                    prop = prop + jnp.sum(
+                        jnp.where(live, d, 0)).astype(jnp.int32)
+                    acc = acc + jnp.sum(n_acc).astype(jnp.int32)
+                r = (cache, toks, pos, emitted, done, out, prop, acc)
+                return r + ((hist,) if d else ())
+
+            zero_i = jnp.zeros((t,), jnp.int32)
+            carry = (cache, toks0, pos0, zero_i, done0,
+                     jnp.full((k + 1, t), -1, jnp.int32),
+                     jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+            if d:
+                carry = carry + (hist,)
+            carry = jax.lax.while_loop(
+                lambda c: jnp.any(~c[4]), body, carry)
+            cache, toks, pos, emitted, _, out, prop, acc = carry[:8]
+            if d:
+                hist = carry[8]
+            sl = jnp.where(real, slots, max_seqs)
+            tok_st = tok_st.at[sl].set(jnp.where(real, toks, tok_st[sl]))
+            pos_st = pos_st.at[sl].set(jnp.where(real, pos, pos_st[sl]))
+            state = (tok_st, pos_st, seed_st, plen_st, temp_st, topk_st,
+                     topp_st)
+            return out[:k], emitted, prop, acc, state, hist, cache
+
+        if d:
+            fn = jax.jit(sched_body, donate_argnums=(1, 2, 3))
+        else:
+            def nohist(params, cache, state, bt_full, staged, root):
+                out, steps, _, _, state, _, cache = sched_body(
+                    params, cache, state, None, bt_full, staged, root)
+                return out, steps, state, cache
+
+            fn = jax.jit(nohist, donate_argnums=(1, 2))
+        self._dev_sched_jits[key] = fn
+        return fn
+
+    def _upload_hist(self, seq: _SeqState) -> None:
+        """Re-seed one slot's device history row from the host's complete
+        view (prompt + generated). Only legal when the slot has no
+        outstanding dispatches (refs drained) — otherwise host ``generated``
+        lags the device position row and the rebuilt history would hold a
+        hole right where the draft matcher reads."""
+        row = np.zeros(self.cfg.max_seq_len, np.int32)
+        toks = list(seq.prompt) + list(seq.generated)
+        row[:len(toks)] = toks
+        self.h2d_bytes += row.nbytes + 4
+        self._hist_dev = self._hist_row_jit(
+            self._hist_dev, np.int32(seq.slot), row)
+        self._hist_stale[seq.slot] = False
+
+    def _dispatch_sched_device(self) -> bool:
+        """Dispatch one multi-step scheduler program when every running
+        sequence is decoding. Mirrors ``_dispatch_chunk_device``'s
+        eligibility/admission rules but budgets PER ROW (rows near their
+        length limit no longer cap the whole chunk — the program retires
+        them in place), advances host positions optimistically by each
+        row's own budget, and queues a pending record carrying the
+        per-row ``steps_taken`` readback."""
+        cfg = self.cfg
+        k_max = cfg.sched_steps
+        seqs = [s for s in self._running.values() if not s.finished]
+        if not seqs or any(not s.in_decode for s in seqs):
+            return False
+        if self._queued and self._free_slots:
+            # bounded chunk under admission pressure, like run-ahead
+            k_max = min(k_max, cfg.run_ahead_admission_cap)
+            if k_max < 1:
+                return False
+        plan = []
+        max_bud = 0
+        for s in seqs:
+            bud = len(s.prompt) + s.max_new_tokens - 1 - s.pos
+            if bud <= 0:
+                continue  # fully scheduled; retires as pending reconciles
+            plan.append(s)
+            max_bud = max(max_bud, min(bud, k_max))
+        if not plan:
+            return False
+        # pow2 round DOWN: the device derives each row's step count as
+        # min(k, limit - pos), so k must never exceed the capacity the
+        # host actually reserved below
+        k = 1 << (max_bud.bit_length() - 1)
+        kept = []
+        for s in plan:
+            k_s = min(k, len(s.prompt) + s.max_new_tokens - 1 - s.pos)
+            if not self._ensure_capacity(s, s.pos + k_s):
+                s.preemptions += 1
+                self.preemptions += 1
+                continue
+            kept.append((s, k_s))
+        if not kept:
+            return False
+        if cfg.spec_draft:
+            stale = [s for s, _ in kept if self._hist_stale[s.slot]]
+            if any(s.refs for s in stale):
+                self._sched_wait = True
+                return False
+            for s in stale:
+                self._upload_hist(s)
+        t0 = time.perf_counter()
+        t = len(kept)
+        bucket = next(b for b in self._buckets if b >= t)
+        slots = np.full(bucket, cfg.max_seqs, np.int32)
+        eos = np.full(bucket, -1, np.int32)
+        limit = np.zeros(bucket, np.int32)
+        sampled = has_tk = has_tp = False
+        max_pos = 0
+        for j, (s, k_s) in enumerate(kept):
+            slots[j] = s.slot
+            if s.eos_token_id is not None:
+                eos[j] = s.eos_token_id
+            limit[j] = len(s.prompt) + s.max_new_tokens - 1
+            sampled = sampled or s.temperature > 0.0
+            has_tk = has_tk or s.top_k > 0
+            has_tp = has_tp or s.top_p < 1.0
+            max_pos = max(max_pos, s.pos + k_s - 1)
+        self._sync_bt()
+        staged = self._stage(np.concatenate([slots, eos, limit]))
+        fn = self._get_dev_sched(k, bucket, self._table_width(max_pos),
+                                 sampled, sampled and has_tk,
+                                 sampled and has_tp)
+        if self._faults.enabled:
+            self._faults.fire(POINT_DISPATCH)
+        if cfg.spec_draft:
+            out, steps, prop, acc, self._dev_state, self._hist_dev, \
+                self.cache = fn(
+                    self.params, self.cache, self._dev_state, self._hist_dev,
+                    self._bt_dev, staged, self._sample_root)
+        else:
+            out, steps, self._dev_state, self.cache = fn(
+                self.params, self.cache, self._dev_state, self._bt_dev,
+                staged, self._sample_root)
+            prop = acc = None
+        emits = []
+        sched_tok = 0
+        for s, k_s in kept:
+            # optimistic: the device may retire the row earlier on EOS;
+            # the overshoot is never rewound — the sequence finishes at
+            # reconcile and releases once its refs drain
+            s.pos += k_s
+            s.refs += 1
+            self._slot_feed[s.slot] = True
+            emits.append((s, k_s))
+            sched_tok += k_s
+        self.tokens_scheduled += sched_tok
+        self.tokens_padded += k * bucket - sched_tok
+        self._pending.append({"kind": "sched", "out": out, "steps": steps,
+                              "prop": prop, "acc": acc, "emits": emits,
+                              "participants": [s for s, _ in kept]})
+        self._note_dispatch(t0)
+        if self._tracer.enabled:
+            self._trace_spans(t0, time.perf_counter(),
+                              [(s, "engine/decode", ks) for s, ks in kept],
+                              mode="dev_sched")
         return True
 
     def _dispatch_step_device(self) -> bool:
@@ -1565,7 +1895,14 @@ class RaggedInferenceEngine:
         for seq in list(self._running.values()):
             if seq.finished or not seq.in_decode or n_dec >= dec_cap:
                 continue
-            if seq.pos >= len(seq.prompt) + seq.max_new_tokens:
+            # the feed at limit-1 yields the final budgeted token; sched
+            # mode uses the exact bound (its own budgets already do), the
+            # legacy modes keep the historical +1 slop (extra token is
+            # discarded at reconcile)
+            lim = len(seq.prompt) + seq.max_new_tokens
+            if cfg.sched_steps >= 2:
+                lim -= 1
+            if seq.pos >= lim:
                 continue  # fully scheduled; retires as pending reconciles
             if not self._ensure_capacity(seq, seq.pos + 1):
                 seq.preemptions += 1
@@ -1663,6 +2000,7 @@ class RaggedInferenceEngine:
             participants[seq.slot] = seq
         for seq in participants.values():
             seq.refs += 1
+            self._hist_stale[seq.slot] = True
         self._pending.append({"kind": "step", "picked": picked,
                               "emit": emit,
                               "participants": list(participants.values())})
@@ -1691,6 +2029,32 @@ class RaggedInferenceEngine:
                                            for _, s in rec["emit"]])
             for row, seq in rec["emit"]:
                 self._append_tokens(seq, [int(picked[row])], out)
+        elif rec["kind"] == "sched":
+            toks = np.asarray(rec["out"])    # [K, bucket]
+            steps = np.asarray(rec["steps"])  # [bucket] device steps_taken
+            t1 = time.perf_counter()
+            self.readback_ns += int((t1 - t0) * 1e9)
+            if self._tracer.enabled:
+                self._trace_spans(t0, t1, [(s, "engine/readback", ks)
+                                           for s, ks in rec["emits"]])
+            for j, (seq, _ks) in enumerate(rec["emits"]):
+                n = int(steps[j])
+                if n:
+                    self._append_tokens(seq, toks[:n, j], out)
+            if rec["prop"] is not None:
+                p = int(np.asarray(rec["prop"]))
+                a = int(np.asarray(rec["acc"]))
+                self.spec_proposed += p
+                self.spec_accepted += a
+                if self.telemetry.enabled and p:
+                    self.telemetry.counter(
+                        "spec_tokens_proposed_total",
+                        "draft tokens proposed by self-speculative "
+                        "decode").inc(p)
+                    self.telemetry.counter(
+                        "spec_tokens_accepted_total",
+                        "draft tokens accepted by exact-match "
+                        "verification").inc(a)
         else:
             toks = np.asarray(rec["out"])  # [K, bucket]
             t1 = time.perf_counter()
@@ -1715,10 +2079,14 @@ class RaggedInferenceEngine:
         step t+1."""
         self._admit_queued()
         dispatched = False
-        if self.cfg.decode_run_ahead >= 2:
-            dispatched = self._dispatch_chunk_device()
-        if not dispatched:
-            dispatched = self._dispatch_step_device()
+        self._sched_wait = False
+        if self.cfg.sched_steps >= 2:
+            dispatched = self._dispatch_sched_device()
+        if not dispatched and not self._sched_wait:
+            if self.cfg.decode_run_ahead >= 2:
+                dispatched = self._dispatch_chunk_device()
+            if not dispatched:
+                dispatched = self._dispatch_step_device()
         if self._pending and (not dispatched or len(self._pending) >= 2):
             return self._reconcile_pending()
         if not dispatched and not self._pending and (
@@ -1926,13 +2294,23 @@ class RaggedInferenceEngine:
             return toks
 
         def chunk_fn(params, cache, slot_toks, tokens, slots, positions,
-                     feed_sel, dec_remaining, pf_last_mask, ts, tp, tv,
-                     block_tables, root, seeds, gidx, temp, topk, topp):
+                     feed_sel, dec_remaining, eos_ids, pf_last_mask, ts, tp,
+                     tv, block_tables, root, seeds, gidx, temp, topk, topp):
             from deepspeed_tpu.inference.sampling import per_request_keys
             if nd:
                 fed = jnp.where(feed_sel > 0, slot_toks[slots[:nd]],
                                 tokens[:nd])
                 tokens = tokens.at[:nd].set(fed)
+                # mid-chunk retirement, entry case: a pipelined chunk can be
+                # dispatched before the host reconciles a row's EOS pick —
+                # its device feed token IS the EOS. Mask the row to the
+                # scratch slot for the whole chunk (no real-state writes, no
+                # surfaced tokens) instead of running it dead for k steps.
+                done0 = (fed == eos_ids[:nd]) & (eos_ids[:nd] >= 0)
+                slots = slots.at[:nd].set(
+                    jnp.where(done0, max_seqs, slots[:nd]))
+                positions = positions.at[:nd].set(
+                    jnp.where(done0, 0, positions[:nd]))
             if nt:
                 logits, cache = fwd(params, tokens, slots, positions,
                                     block_tables, cache,
@@ -1950,10 +2328,17 @@ class RaggedInferenceEngine:
                 sl_pf = jnp.where(mask, slots[nd:], max_seqs)
                 st = st.at[sl_pf].set(
                     jnp.where(mask, tok0[nd:], st[sl_pf]))
+            if nd:
+                # mid-chunk retirement, in-scan case: a row that picks its
+                # EOS stops running (scratch-routed like frozen rows) and
+                # its remaining steps surface -1 sentinels, never tokens
+                eosd = eos_ids[:nd]
+                dec0 = jnp.where(done0, -1, tok0[:nd])
+                last_feed = tok0[:nd]
             if nd and k > 1:
                 def one(carry, i):
-                    cache, toks, pos = carry
-                    active = i < dec_remaining
+                    cache, toks, pos, done = carry
+                    active = (i < dec_remaining) & ~done
                     # frozen rows (k_s exhausted) must not touch real state:
                     # slot -> max_seqs routes their KV writes to the all-zero
                     # scratch row of the block table (block 0, never
@@ -1967,38 +2352,124 @@ class RaggedInferenceEngine:
                     lg, cache = fwd(params, toks, s, p, block_tables, cache)
                     r = per_request_keys(root, seeds[:nd], gidx[:nd] + i)
                     nxt = pick(lg, r, temp[:nd], topk[:nd], topp[:nd])
-                    # frozen rows keep their last token (feed stability)
+                    # frozen/retired rows keep their last token (feed
+                    # stability); only live picks are surfaced
                     nxt = jnp.where(active, nxt, toks)
-                    return (cache, nxt, pos + 1), nxt
+                    done = done | (active & (nxt == eosd) & (eosd >= 0))
+                    return (cache, nxt, pos + 1, done), \
+                        jnp.where(active, nxt, -1)
 
-                (cache, _, _), rest = jax.lax.scan(
-                    one, (cache, tok0[:nd], positions[:nd] + 1),
+                hit0 = done0 | ((tok0[:nd] == eosd) & (eosd >= 0))
+                (cache, last_feed, _, _), rest = jax.lax.scan(
+                    one, (cache, tok0[:nd], positions[:nd] + 1, hit0),
                     jnp.arange(1, k))
-                dec_toks = jnp.concatenate([tok0[:nd][None], rest], axis=0)
+                dec_toks = jnp.concatenate([dec0[None], rest], axis=0)
             else:
-                dec_toks = (tok0[:nd][None] if nd
+                dec_toks = (dec0[None] if nd
                             else jnp.zeros((1, 0), jnp.int32))
             if nd:
-                last_i = jnp.clip(dec_remaining, 1, k) - 1
-                last_tok = dec_toks[last_i, jnp.arange(nd)]
-                st = st.at[slots[:nd]].set(last_tok)
+                # next chunk's device feed: the final carry token — equal to
+                # the k_s-th emitted token for full rows, the frozen token
+                # for short rows, the EOS for mid-scan-retired rows (done0
+                # rows scatter to scratch via their masked slot)
+                st = st.at[slots[:nd]].set(last_feed)
             return dec_toks, tok0, st, cache
 
         fn = jax.jit(chunk_fn, donate_argnums=(1, 2))
         self._fused_jits[key] = fn
         return fn
 
+    def _width_ladder(self) -> list[int]:
+        """Block-table widths ``_table_width`` can actually dispatch (jit
+        caches are shape-keyed; warming the wrong width warms nothing)."""
+        mb = self.cfg.max_blocks_per_seq
+        if mb <= 64:
+            return [mb]
+        widths, b = [], 16
+        while b < mb:
+            widths.append(b)
+            b *= 4
+        widths.append(mb)
+        return widths
+
     def warmup(self, sampled: bool = False, has_tk: bool = False,
                has_tp: bool = False) -> int:
-        """Precompile the fused-chunk program zoo via ``lower().compile()``
-        (no execution, no engine state touched). On a remote-compile
-        transport every NOVEL (k, nd, nt) combo otherwise costs seconds of
-        compilation in the middle of serving — measured as 4-5 s stalls that
-        dominated staggered-arrival latency. Returns the number of programs
-        compiled. Greedy combos by default; call again with ``sampled``/
-        filter flags for sampling workloads."""
-        if self.cfg.fused_chunk < 2:
-            return 0
+        """Precompile the engine's multi-step program zoos via
+        ``lower().compile()`` (no execution, no engine state touched): the
+        fused-chunk family when ``fused_chunk`` >= 2 and the multi-step
+        scheduler family when ``sched_steps`` >= 2. On a remote-compile
+        transport every NOVEL combo otherwise costs seconds of compilation
+        in the middle of serving — measured as 4-5 s stalls that dominated
+        staggered-arrival latency. Returns the number of programs compiled.
+        Greedy combos by default; call again with ``sampled``/filter flags
+        for sampling workloads."""
+        n = 0
+        if self.cfg.fused_chunk >= 2:
+            n += self._warmup_fused(sampled, has_tk, has_tp)
+        if self.cfg.sched_steps >= 2 and self.cfg.device_state:
+            n += self._warmup_sched(sampled, has_tk, has_tp)
+        # warmup's own program-cache fills are not serve-time misses: reset
+        # the dispatch baseline so warmup_coverage reflects live traffic only
+        self._warmed = True
+        self.program_dispatches = 0
+        self.program_cold_dispatches = 0
+        return n
+
+    def _warmup_sched(self, sampled: bool, has_tk: bool,
+                      has_tp: bool) -> int:
+        """Lower the multi-step scheduler programs the dispatcher can reach:
+        k is the pow2 round-DOWN of the deepest per-row budget (every pow2
+        <= sched_steps), t the bucket for 1..max_seqs rows, width from the
+        table ladder."""
+        cfg = self.cfg
+        ks = set()
+        p = 1
+        while p <= cfg.sched_steps:
+            ks.add(p)
+            p *= 2
+        bmax = next(b for b in self._buckets if b >= cfg.max_seqs)
+        buckets = [b for b in self._buckets if b <= bmax]
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.params)
+        cache_abs = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.cache)
+        state_abs = tuple(
+            jax.ShapeDtypeStruct((cfg.max_seqs + 1,), dt)
+            for dt in (jnp.int32, jnp.int32, jnp.int32, jnp.int32,
+                       jnp.float32, jnp.int32, jnp.float32))
+        btf_abs = jax.ShapeDtypeStruct(self.block_tables.shape, jnp.int32)
+        hist_abs = jax.ShapeDtypeStruct(
+            (cfg.max_seqs + 1, cfg.max_seq_len), jnp.int32)
+        rng_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        n = 0
+        for kk in sorted(ks):
+            for b in buckets:
+                for w in self._width_ladder():
+                    try:
+                        fn = self._get_dev_sched(kk, b, w, sampled,
+                                                 sampled and has_tk,
+                                                 sampled and has_tp)
+                        staged_abs = jax.ShapeDtypeStruct((3 * b,),
+                                                          jnp.int32)
+                        if cfg.spec_draft:
+                            fn.lower(abstract, cache_abs, state_abs,
+                                     hist_abs, btf_abs, staged_abs,
+                                     rng_abs).compile()
+                        else:
+                            fn.lower(abstract, cache_abs, state_abs,
+                                     btf_abs, staged_abs,
+                                     rng_abs).compile()
+                        n += 1
+                    except Exception as e:  # pragma: no cover
+                        from deepspeed_tpu.utils.logging import logger
+
+                        logger.warning(
+                            "warmup: sched combo (k=%s t=%s w=%s) failed "
+                            "to precompile: %s", kk, b, w, e)
+        return n
+
+    def _warmup_fused(self, sampled: bool, has_tk: bool,
+                      has_tp: bool) -> int:
         cfg = self.cfg
         ct = cfg.prefill_tile if self._use_tiles else 0
         k = cfg.fused_chunk
@@ -2041,17 +2512,7 @@ class RaggedInferenceEngine:
         cache_abs = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.cache)
         st_abs = jax.ShapeDtypeStruct((cfg.max_seqs + 1,), jnp.int32)
-        # table widths must match what _table_view will actually dispatch
-        # (jit caches are shape-keyed; warming the wrong width warms nothing)
-        mb = cfg.max_blocks_per_seq
-        if mb <= 64:
-            widths = [mb]
-        else:
-            widths, b = [], 16
-            while b < mb:
-                widths.append(b)
-                b *= 4
-            widths.append(mb)
+        widths = self._width_ladder()
         rng_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
         n = 0
         combos = {(kk, nd, nt, w) for kk, nd, nt in combos for w in widths}
@@ -2079,7 +2540,7 @@ class RaggedInferenceEngine:
                                    jnp.float32))
                     btf_abs = jax.ShapeDtypeStruct(
                         self.block_tables.shape, jnp.int32)
-                    slen = 4 * t_total + max(nd, 1)
+                    slen = 4 * t_total + 2 * max(nd, 1)
                     if nt_prog:
                         slen += 3 * max(nt_prog, 1)
                     fn = self._get_dev_fused(t_total, kk, nd, nt_prog, w,
@@ -2092,7 +2553,8 @@ class RaggedInferenceEngine:
                     fn.lower(
                         abstract, cache_abs, st_abs,
                         i32(t_total), i32(t_total), i32(t_total),
-                        i32(max(nd, 1)), i32(max(nd, 1)), i32(t_total),
+                        i32(max(nd, 1)), i32(max(nd, 1)), i32(max(nd, 1)),
+                        i32(t_total),
                         i32(max(nt_prog, 1)), i32(max(nt_prog, 1)),
                         i32(max(nt_prog, 1)),
                         bt_abs, rng_abs, i32(t_total), i32(t_total),
@@ -2104,11 +2566,6 @@ class RaggedInferenceEngine:
 
                 logger.warning("warmup: combo (k=%s nd=%s nt=%s) failed to "
                                "precompile: %s", kk, nd, nt, e)
-        # warmup's own program-cache fills are not serve-time misses: reset
-        # the dispatch baseline so warmup_coverage reflects live traffic only
-        self._warmed = True
-        self.program_dispatches = 0
-        self.program_cold_dispatches = 0
         return n
 
     def _dispatch_fused(self) -> bool:
@@ -2186,6 +2643,7 @@ class RaggedInferenceEngine:
         positions = np.zeros(max(t_total, 1), np.int32)
         feed_sel = np.zeros(max(nd, 1), np.int32)
         dec_remaining = np.zeros(max(nd, 1), np.int32)
+        eos_row = np.full(max(nd, 1), -1, np.int32)
         pf_last = np.zeros(max(t_total, 1), np.int32)
         seeds = np.zeros(max(t_total, 1), np.int32)
         gidx = np.zeros(max(t_total, 1), np.int32)
@@ -2198,6 +2656,8 @@ class RaggedInferenceEngine:
             slots[j] = seq.slot
             positions[j] = seq.pos
             dec_remaining[j] = k_s
+            if seq.eos_token_id is not None:
+                eos_row[j] = seq.eos_token_id
             # step 0 feeds token_at(pos) -> emits generated index
             # pos - len(prompt) + 1; scan step i emits that + i
             seeds[j] = seq.seed
@@ -2258,8 +2718,9 @@ class RaggedInferenceEngine:
             self.params, self.cache, self._slot_toks,
             self._h2d(tokens), self._h2d(slots), self._h2d(positions),
             self._h2d(feed_sel), self._h2d(dec_remaining),
-            self._h2d(pf_last), self._h2d(ts), self._h2d(tpos),
-            self._h2d(tval), self._h2d(self._table_view(max_pos)),
+            self._h2d(eos_row), self._h2d(pf_last), self._h2d(ts),
+            self._h2d(tpos), self._h2d(tval),
+            self._h2d(self._table_view(max_pos)),
             self._sample_root, self._h2d(seeds), self._h2d(gidx),
             self._h2d(temp), self._h2d(topk), self._h2d(topp),
         )
@@ -2284,6 +2745,7 @@ class RaggedInferenceEngine:
             participants[seq.slot] = seq
         for seq in participants.values():
             seq.refs += 1
+            self._hist_stale[seq.slot] = True
         self._inflight_chunks.append({
             "dec_toks": dec_toks, "tok0": tok0,
             "decs": decs, "pf_done": pf_done,
@@ -2331,10 +2793,21 @@ class RaggedInferenceEngine:
             positions = staged[2 * t:3 * t]
             flags = staged[3 * t:4 * t]
             dec_rem = staged[4 * t:4 * t + ndl]
-            real = slots != max_seqs
+            eos_ids = staged[4 * t + ndl:4 * t + 2 * ndl]
             feed = (flags & 1) > 0
+            live0 = slots != max_seqs
             tokens = jnp.where(feed, tok_st[slots], tokens)
-            positions = jnp.where(feed & real, pos_st[slots], positions)
+            positions = jnp.where(feed & live0, pos_st[slots], positions)
+            if nd:
+                # mid-chunk retirement, entry case (see _get_fused_chunk):
+                # a row whose device feed is already its EOS masks to the
+                # scratch slot for the whole chunk
+                done0 = (tokens[:nd] == eos_ids[:nd]) & (eos_ids[:nd] >= 0)
+                slots = slots.at[:nd].set(
+                    jnp.where(done0, max_seqs, slots[:nd]))
+                positions = positions.at[:nd].set(
+                    jnp.where(done0, 0, positions[:nd]))
+            real = slots != max_seqs
             seeds = seed_st[slots]
             temp = temp_st[slots]
             topk = topk_st[slots]
@@ -2342,9 +2815,10 @@ class RaggedInferenceEngine:
             gidx = positions - plen_st[slots] + 1
             bt = bt_full[:, :w] if w < bt_full.shape[1] else bt_full
             if nt:
-                ts = staged[4 * t + ndl:4 * t + ndl + ntl]
-                tp_ = staged[4 * t + ndl + ntl:4 * t + ndl + 2 * ntl]
-                tv = staged[4 * t + ndl + 2 * ntl:4 * t + ndl + 3 * ntl]
+                ts = staged[4 * t + 2 * ndl:4 * t + 2 * ndl + ntl]
+                tp_ = staged[4 * t + 2 * ndl + ntl:4 * t + 2 * ndl + 2 * ntl]
+                tv = staged[4 * t + 2 * ndl + 2 * ntl:
+                            4 * t + 2 * ndl + 3 * ntl]
                 logits, cache = fwd(params, tokens, slots, positions, bt,
                                     cache, prefill_tiles=(nd, ts, tp_, tv, ct))
             else:
@@ -2364,33 +2838,39 @@ class RaggedInferenceEngine:
                 sl_p = jnp.where(mpf, slots[nd:], max_seqs)
                 pos_st = pos_st.at[sl_p].max(
                     jnp.where(mpf, positions[nd:] + 1, 0))
+            if nd:
+                # mid-chunk retirement, in-scan case (see _get_fused_chunk)
+                eosd = eos_ids[:nd]
+                dec0 = jnp.where(done0, -1, tok0[:nd])
+                last_feed = tok0[:nd]
             if nd and k > 1:
                 def one(carry, i):
-                    cache, toks, pos = carry
-                    active = i < dec_rem
-                    # frozen rows -> scratch (see _get_fused_chunk)
+                    cache, toks, pos, done = carry
+                    active = (i < dec_rem) & ~done
+                    # frozen/retired rows -> scratch (see _get_fused_chunk)
                     s = jnp.where(active, slots[:nd], max_seqs)
                     p = jnp.where(active, pos, 0)
                     lg, cache = fwd(params, toks, s, p, bt, cache)
                     r = per_request_keys(root, seeds[:nd], gidx[:nd] + i)
                     nxt = pick(lg, r, temp[:nd], topk[:nd], topp[:nd])
                     nxt = jnp.where(active, nxt, toks)
-                    return (cache, nxt, pos + 1), nxt
+                    done = done | (active & (nxt == eosd) & (eosd >= 0))
+                    return (cache, nxt, pos + 1, done), \
+                        jnp.where(active, nxt, -1)
 
-                (cache, _, _), rest = jax.lax.scan(
-                    one, (cache, tok0[:nd], positions[:nd] + 1),
+                hit0 = done0 | ((tok0[:nd] == eosd) & (eosd >= 0))
+                (cache, last_feed, _, _), rest = jax.lax.scan(
+                    one, (cache, tok0[:nd], positions[:nd] + 1, hit0),
                     jnp.arange(1, k))
-                dec_toks = jnp.concatenate([tok0[:nd][None], rest], axis=0)
+                dec_toks = jnp.concatenate([dec0[None], rest], axis=0)
             else:
-                dec_toks = (tok0[:nd][None] if nd
+                dec_toks = (dec0[None] if nd
                             else jnp.zeros((1, 0), jnp.int32))
             if nd:
-                last_i = jnp.clip(dec_rem, 1, k) - 1
-                last_tok = dec_toks[last_i, jnp.arange(nd)]
-                rd = real[:nd]
+                rd = real[:nd]  # done0 rows already masked -> scratch
                 sl_d = jnp.where(rd, slots[:nd], max_seqs)
                 tok_st = tok_st.at[sl_d].set(
-                    jnp.where(rd, last_tok, tok_st[sl_d]))
+                    jnp.where(rd, last_feed, tok_st[sl_d]))
                 pos_st = pos_st.at[sl_d].add(
                     jnp.where(rd, jnp.minimum(dec_rem, k), 0))
             state = (tok_st, pos_st, seed_st, plen_st, temp_st, topk_st,
@@ -2415,12 +2895,15 @@ class RaggedInferenceEngine:
         positions = np.zeros(max(t_total, 1), np.int32)
         flags = np.zeros(max(t_total, 1), np.int32)
         dec_remaining = np.zeros(max(nd, 1), np.int32)
+        eos_row = np.full(max(nd, 1), -1, np.int32)
         sampled = has_tk = has_tp = False
         max_pos = 0
         for j, (seq, k_s) in enumerate(decs):
             slots[j] = seq.slot
             flags[j] = 1  # feed token + position from device state
             dec_remaining[j] = k_s
+            if seq.eos_token_id is not None:
+                eos_row[j] = seq.eos_token_id
             sampled = sampled or seq.temperature > 0.0
             has_tk = has_tk or seq.top_k > 0
             has_tp = has_tp or seq.top_p < 1.0
@@ -2454,7 +2937,7 @@ class RaggedInferenceEngine:
         self.tokens_scheduled += n0 + active_scan
         self.tokens_padded += (t_total - n0) + (k - 1) * nd - active_scan
 
-        parts = [tokens, slots, positions, flags, dec_remaining]
+        parts = [tokens, slots, positions, flags, dec_remaining, eos_row]
         if nt:
             parts += [ts, tpos, tval]
         self._sync_bt()
@@ -2480,6 +2963,7 @@ class RaggedInferenceEngine:
             participants[seq.slot] = seq
         for seq in participants.values():
             seq.refs += 1
+            self._hist_stale[seq.slot] = True
         self._inflight_chunks.append({
             "dec_toks": dec_toks, "tok0": tok0,
             "decs": decs, "pf_done": pf_done,
@@ -2551,6 +3035,37 @@ class RaggedInferenceEngine:
         if not dispatched and not self._inflight_chunks:
             self._deadlock_guard(0)
         return {}
+
+    def _sched_eligible(self) -> bool:
+        """Whether a multi-step scheduler turn could engage right now:
+        everything running is decoding and admission pressure does not
+        forbid a chunk (same preconditions ``_dispatch_sched_device``
+        checks before planning)."""
+        seqs = [s for s in self._running.values() if not s.finished]
+        if not seqs or any(not s.in_decode for s in seqs):
+            return False
+        if self._queued and self._free_slots and \
+                min(self.cfg.sched_steps,
+                    self.cfg.run_ahead_admission_cap) < 1:
+            return False
+        return True
+
+    def _step_fused_sched(self) -> dict:
+        """Fused pipeline with the multi-step scheduler layered on top:
+        mixed prefill+decode waves run through the fused-chunk program;
+        once the batch is all-decode the turn switches to the scheduler
+        dispatch (device-side retirement, optional speculation). The two
+        in-flight queues never interleave — each family's window drains
+        fully before the other dispatches — so reconcile order stays FIFO
+        per sequence."""
+        self._admit_queued()
+        if self._sched_eligible():
+            if self._inflight_chunks:
+                return self._reconcile_oldest()
+            return self._step_device()
+        if self._pending:
+            return self._reconcile_pending()
+        return self._step_fused()
 
     def drain(self) -> dict:
         """Reconcile every in-flight chunk (a flush point for callers that
@@ -2780,6 +3295,8 @@ class RaggedInferenceEngine:
         # table wholesale and re-seed the slot rows from host truth
         self._bt_dirty.clear()
         self._bt_dev = jnp.asarray(self.block_tables)
+        self._hist_stale[:] = True
+        self._sched_wait = False
         if self.cfg.device_state:
             for seq in self._running.values():
                 self._write_slot_row(seq)
@@ -2939,6 +3456,10 @@ class RaggedInferenceEngine:
             jnp.zeros(s1, jnp.float32), jnp.zeros(s1, jnp.int32),
             jnp.ones(s1, jnp.float32),
         )
+        self._hist_dev = (jnp.zeros((s1, self.cfg.max_seq_len), jnp.int32)
+                          if self.cfg.spec_draft else None)
+        self._hist_stale[:] = True
+        self._sched_wait = False
         self.cache = self.spec.init_paged_cache_fn(
             self.cfg.num_blocks, self.cfg.block_size, self.dtype)
         self._consec_failures = 0
@@ -2983,6 +3504,15 @@ class RaggedInferenceEngine:
             self.tokens_padded)
         g("inference_dispatch_count", "device dispatches issued").set(
             self.dispatch_count)
+        if self.tokens_emitted:
+            g("ragged_dispatches_per_token",
+              "device dispatches divided by tokens emitted (multi-step "
+              "scheduling + speculation drive this toward 0)").set(
+                  self.dispatch_count / self.tokens_emitted)
+        if self.spec_proposed:
+            g("spec_acceptance_rate",
+              "accepted / proposed draft tokens (cumulative)").set(
+                  self.spec_accepted / self.spec_proposed)
         g("degraded_mode",
           "0 full | 1 host-staged fallback | 2 plain-step fallback").set(
               self.degraded_mode)
@@ -3001,8 +3531,8 @@ class RaggedInferenceEngine:
         tel.note_program_cache_size(
             len(self._tiled_jits) + len(self._fused_jits)
             + len(self._dev_step_jits) + len(self._dev_chunk_jits)
-            + len(self._dev_fused_jits) + len(self._chunk_keys)
-            + len(self._step_keys))
+            + len(self._dev_fused_jits) + len(self._dev_sched_jits)
+            + len(self._chunk_keys) + len(self._step_keys))
         if self.cfg.enable_prefix_cache:
             alloc = self.allocator
             if alloc.evictions > self._evictions_seen:
@@ -3027,6 +3557,8 @@ class RaggedInferenceEngine:
         if not self.has_work:
             return {}  # the sweep retired everything schedulable
         if self.cfg.fused_chunk >= 2:
+            if self.cfg.sched_steps >= 2 and self.cfg.device_state:
+                return self._step_fused_sched()
             return self._step_fused()
         if self.cfg.device_state:
             return self._step_device()

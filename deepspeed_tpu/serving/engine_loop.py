@@ -134,6 +134,12 @@ class ReplicaStats:
     # (handoff exports), "decode" replicas adopt handoffs; "unified" does
     # everything. plan_placement() filters on this.
     role: str = "unified"
+    # multi-step scheduled decode: dispatches per emitted token (1.0 for a
+    # per-token engine; the device-side scheduler drives it toward 1/K) and
+    # the self-speculation draft economy, for cluster-level observability
+    dispatches_per_token: float = 1.0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     def worst_blocks(self, total_tokens: int) -> int:
         return -(-total_tokens // self.block_size)
@@ -382,7 +388,12 @@ class EngineLoop:
             max_request_tokens=self._max_request_tokens,
             degraded=int(getattr(self._engine, "degraded_mode", 0)),
             crashes=self.crash_count, respawns=self.respawn_count,
-            role=self.role)
+            role=self.role,
+            dispatches_per_token=(
+                getattr(self._engine, "dispatch_count", 0)
+                / max(getattr(self._engine, "tokens_emitted", 0), 1)),
+            spec_proposed=int(getattr(self._engine, "spec_proposed", 0)),
+            spec_accepted=int(getattr(self._engine, "spec_accepted", 0)))
 
     # ------------------------------------------------------- loop internals
     def _drain_inbox(self) -> None:
