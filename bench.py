@@ -1043,7 +1043,7 @@ def serving_bench_main():
     tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "runs",
         "BENCH_serving_telemetry.jsonl"))
-    telemetry.configure(enabled=True, jsonl_path=tel_path)
+    telemetry.configure(enabled=True, jsonl_path=tel_path, memledger=True)
 
     if shared_prefix >= max_prompt:
         raise SystemExit(f"BENCH_SERVING_SHARED_PREFIX={shared_prefix} must "
@@ -1152,6 +1152,21 @@ def serving_bench_main():
         "serving_prefix_cache_evictions": engine.allocator.evictions,
         "serving_tokens_scheduled": engine.tokens_scheduled,
     } if shared_prefix > 0 else {}
+    # memory-ledger picture BEFORE close() tears the ledger down: per-owner
+    # bytes + the final census gap (the leak detector's reading for the run)
+    led = telemetry.TELEMETRY.memledger
+    memory = {}
+    if led is not None:
+        census = led.census()
+        memory = {
+            "owners": {k: v for k, v in led.owner_bytes().items() if v},
+            "attributed_bytes": census["attributed_bytes"],
+            "live_bytes": census["live_bytes"],
+            "unattributed_bytes": census["unattributed_bytes"],
+            "unattributed_fraction": census["unattributed_fraction"],
+            "drift_alarm": census["drift_alarm"],
+            "oom_reports": list(led.oom_reports),
+        }
     telemetry.TELEMETRY.close()
     print(json.dumps({
         "metric": "serving_frontend_poisson",
@@ -1169,6 +1184,7 @@ def serving_bench_main():
         if gaps_s else None,
         "serving_goodput_tokens_per_s": round(goodput, 1),
         "serving_wall_s": round(wall, 2),
+        "memory": memory,
         "backend": jax.default_backend(),
         "telemetry_jsonl": tel_path,
     }))

@@ -68,6 +68,10 @@ class Accelerator(abc.ABC):
         stats = self.memory_stats(device)
         return max(stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0), 0)
 
+    def memory_stats_all_devices(self) -> list[dict[str, int]]:
+        """Per-local-device stats rows (default: one aggregate row)."""
+        return [self.memory_stats()]
+
     # ------------------------------------------------------------ execution
     def synchronize(self) -> None:
         import jax

@@ -50,16 +50,31 @@ class TpuAccelerator(Accelerator):
     def supports_pallas(self) -> bool:
         return True
 
+    # core keys plus the allocator-shape extras (fragmentation = reserved
+    # minus in-use; largest_free_block bounds the biggest single allocation
+    # that can still succeed) — passed through only where the backend
+    # reports them
+    _STAT_EXTRAS = ("bytes_reserved", "largest_free_block_bytes",
+                    "num_allocs", "bytes_reservable_limit")
+
     def memory_stats(self, device=None) -> dict[str, int]:
         import jax
 
         device = device or jax.local_devices()[0]
         stats = getattr(device, "memory_stats", lambda: None)() or {}
-        return {
+        out = {
             "bytes_in_use": stats.get("bytes_in_use", 0),
             "bytes_limit": stats.get("bytes_limit", 0),
             "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
         }
+        for key in self._STAT_EXTRAS:
+            if key in stats:
+                out[key] = stats[key]
+        return out
+
+    def memory_stats_all_devices(self) -> list[dict[str, int]]:
+        """Per-local-device stats, index-aligned with ``devices()``."""
+        return [self.memory_stats(d) for d in self.devices()]
 
     def pinned_memory_sharding(self):
         import jax
@@ -109,6 +124,10 @@ class CpuAccelerator(Accelerator):
         except Exception:
             rss = 0
         return {"bytes_in_use": rss, "bytes_limit": 0, "peak_bytes_in_use": rss}
+
+    def memory_stats_all_devices(self) -> list[dict[str, int]]:
+        # simulated CPU devices share one host process: one stats row
+        return [self.memory_stats()]
 
 
 _accelerator: Accelerator | None = None

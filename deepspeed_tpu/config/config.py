@@ -494,6 +494,11 @@ class TelemetryConfig(ConfigBase):
     # MFU attribution, overlap + goodput gauges. Enabling it settles every
     # step (microscope mode) and implies the trace ring on.
     stepscope: dict = field(default_factory=dict)
+    # {enabled, census_interval_steps, drift_threshold, drift_consecutive,
+    # report_dir} or bare true: HBM memory ledger (telemetry/memledger.py) —
+    # per-owner byte attribution, jax.live_arrays() leak census, OOM crash
+    # reports, headroom-driven admission inputs
+    memledger: dict = field(default_factory=dict)
 
     def _validate(self, path: str = "") -> None:
         if self.flush_interval_events < 1:

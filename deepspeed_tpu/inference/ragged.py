@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import random
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -44,6 +45,7 @@ from deepspeed_tpu.serving.faults import (
     get_fault_injector,
 )
 from deepspeed_tpu.telemetry import get_telemetry
+from deepspeed_tpu.telemetry.memledger import is_resource_exhausted, record_oom
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -120,6 +122,19 @@ class BlockedAllocator:
         self.evictions += 1
         if self.listener is not None:
             self.listener.on_evict(key)
+
+    def shrink_retained(self, budget: int) -> int:
+        """Evict LRU cached blocks until at most ``budget`` refcount-0
+        blocks stay retained (headroom-driven cache budget: when measured
+        free-byte headroom is scarce, retention shrinks before admission
+        starves). Returns how many blocks were evicted; a budget at or
+        above the current retention is a no-op — the ample-headroom case
+        stays bit-identical to the unbudgeted LRU."""
+        n = 0
+        while len(self._lru) > max(0, budget):
+            self._evict_lru()
+            n += 1
+        return n
 
     def free(self, blocks: list[int]) -> None:
         """Drop one reference per block; a block reaching refcount 0 returns
@@ -260,6 +275,15 @@ class RaggedConfig:
     # purely by free memory. Off by default: disabled, scheduling behavior
     # is bit-identical to an uncached engine.
     enable_prefix_cache: bool = False
+    # headroom-driven admission (telemetry/memledger.py): cap admission and
+    # the prefix-cache LRU by MEASURED free-byte headroom instead of static
+    # block counts. A backend that reports no bytes_limit (the CPU test
+    # accelerator) yields "unknown" headroom and the static path verbatim,
+    # so default behavior is bit-identical off-TPU.
+    headroom_admission: bool = True
+    # fraction of bytes_limit held back from the measured free bytes before
+    # converting headroom to KV blocks (allocator slack + fragmentation)
+    headroom_guard_fraction: float = 0.05
 
     @property
     def max_seq_len(self) -> int:
@@ -651,6 +675,18 @@ class RaggedInferenceEngine:
         self.step_failures = 0   # transient device-path failures observed
         self.step_retries = 0    # in-place retries the watchdog issued
         self._consec_failures = 0
+        # ---- memory ledger (telemetry/memledger.py) ----
+        # per-owner byte attribution: fixed allocations (KV pool, device
+        # scheduler rows, spec history) register handles; derived owners
+        # (prefix LRU, parked handoffs, staging cache) register weakref'd
+        # providers. All of it only exists when the ledger is configured —
+        # with it off this is one attribute read and two None stores.
+        self._kv_block_bytes: int | None = None
+        self._mem_stats_fn: Callable | None = None  # test hook: fake stats
+        self._memledger_handles: dict | None = None
+        self._headroom_wait = False  # admission pinned by measured headroom
+        self.last_oom_report: str | None = None
+        self._register_memory_owners()
         log_dist(
             f"RaggedInferenceEngine: model={self.spec.name} "
             f"budget={self.cfg.max_tokens_per_step} max_seqs={self.cfg.max_seqs} "
@@ -884,6 +920,146 @@ class RaggedInferenceEngine:
                 * a.dtype.itemsize
             total += per_block // bs
         return total
+
+    def _block_bytes(self) -> int:
+        """Bytes one KV block occupies across all cache leaves (cached)."""
+        if self._kv_block_bytes is None:
+            self._kv_block_bytes = \
+                self.kv_bytes_per_token() * self.cfg.block_size
+        return self._kv_block_bytes
+
+    # ------------------------------------------------------- memory ledger
+    def _register_memory_owners(self) -> None:
+        """Attribute this engine's long-lived device allocations to ledger
+        owners. Providers close over a weakref so a retired engine is never
+        pinned by the process-wide ledger (a dead ref returns None, which
+        the ledger prunes)."""
+        led = self.telemetry.memledger
+        if led is None:
+            return
+        h = {
+            "params": led.register("params", "ragged/model_params",
+                                   self.params),
+            "kv_pool": led.register("kv_pool", "ragged/paged_kv_cache",
+                                    self.cache),
+            "device_sched_state": led.register(
+                "device_sched_state", "ragged/slot_rows+block_table",
+                (self._dev_state, self._bt_dev, self._slot_toks)),
+        }
+        if self._hist_dev is not None:
+            h["spec_lanes"] = led.register(
+                "spec_lanes", "ragged/spec_token_history", self._hist_dev)
+        self._memledger_handles = h
+        ref = weakref.ref(self)
+
+        def _staging_bytes():
+            eng = ref()
+            if eng is None:
+                return None
+            return sum(len(b) for b, _ in eng._staging_cache.values())
+
+        def _prefix_retained_bytes():
+            eng = ref()
+            if eng is None:
+                return None
+            return eng.allocator.retained_blocks * eng._block_bytes()
+
+        def _handoff_bytes():
+            eng = ref()
+            if eng is None:
+                return None
+            return sum(len(s.blocks) for s in eng._handoffs.values()) \
+                * eng._block_bytes()
+
+        led.register_provider("staging_buffers", "ragged/staging_cache",
+                              _staging_bytes)
+        led.register_provider("prefix_cache_retained", "ragged/prefix_lru",
+                              _prefix_retained_bytes)
+        led.register_provider("kv_handoff", "ragged/parked_handoffs",
+                              _handoff_bytes)
+
+    def _refresh_memory_handles(self) -> None:
+        """Re-measure ledger handles after crash containment rebuilt the
+        cache/state arrays (the old buffers are garbage now)."""
+        led = self.telemetry.memledger
+        h = self._memledger_handles
+        if led is None or h is None:
+            return
+        led.update(h["kv_pool"], self.cache)
+        led.update(h["device_sched_state"],
+                   (self._dev_state, self._bt_dev, self._slot_toks))
+        if "spec_lanes" in h:
+            led.update(h["spec_lanes"], self._hist_dev)
+
+    def _note_oom(self, seam: str, exc: BaseException) -> None:
+        """OOM forensics: snapshot the per-owner breakdown + census into a
+        crash-report JSON the moment RESOURCE_EXHAUSTED surfaces (never
+        raises; marks the exception so nested seams report once)."""
+        if getattr(exc, "_oom_recorded", False):
+            return
+        try:
+            exc._oom_recorded = True
+        except Exception:
+            pass
+        path = record_oom(seam, exc, context={
+            "running": len(self._running),
+            "queued": len(self._queued),
+            "free_blocks": self.allocator.free_blocks,
+            "reserved_blocks": self._reserved,
+            "retained_blocks": self.allocator.retained_blocks,
+            "degraded_mode": self.degraded_mode,
+        })
+        if path is not None:
+            self.last_oom_report = path
+
+    # --------------------------------------------- headroom-driven admission
+    def _device_memory_stats(self) -> dict:
+        if self._mem_stats_fn is not None:
+            try:
+                return self._mem_stats_fn() or {}
+            except Exception:
+                return {}
+        try:
+            from deepspeed_tpu.accelerator.real_accelerator import (
+                get_accelerator,
+            )
+
+            return get_accelerator().memory_stats() or {}
+        except Exception:
+            return {}
+
+    def admission_headroom_blocks(self) -> int:
+        """MEASURED free-byte headroom expressed in KV blocks: how many
+        block-sized allocations the device could actually fund right now,
+        after a guard band. -1 = unknown (no ``bytes_limit`` reported, or
+        headroom admission disabled) — callers must fall back to the static
+        block-count path, bit-identically."""
+        cfg = self.cfg
+        if not cfg.headroom_admission:
+            return -1
+        stats = self._device_memory_stats()
+        limit = int(stats.get("bytes_limit") or 0)
+        if limit <= 0:
+            return -1
+        free = limit - int(stats.get("bytes_in_use") or 0)
+        usable = free - int(cfg.headroom_guard_fraction * limit)
+        return max(0, usable // max(1, self._block_bytes()))
+
+    def _enforce_retained_budget(self) -> int:
+        """Re-derive the prefix-cache LRU budget from measured headroom:
+        retention may hold at most as many blocks as the device could fund
+        again. Unknown headroom (or ample headroom) leaves the LRU
+        untouched — static-path parity."""
+        hb = self.admission_headroom_blocks()
+        if hb < 0:
+            return 0
+        evicted = self.allocator.shrink_retained(hb)
+        if evicted and self.telemetry.enabled:
+            self.telemetry.counter(
+                "prefix_cache_headroom_evictions_total",
+                "cached blocks evicted by the headroom-driven LRU budget",
+            ).inc(evicted)
+        return evicted
 
     def _kv_jits(self):
         if "g" not in self._kv_gather_jits:
@@ -1155,7 +1331,12 @@ class RaggedInferenceEngine:
         if len(seq.blocks) + need > self.cfg.max_blocks_per_seq:
             return False
         if self._faults.enabled:
-            self._faults.fire(POINT_ALLOC, request_id=str(seq.uid))
+            try:
+                self._faults.fire(POINT_ALLOC, request_id=str(seq.uid))
+            except Exception as e:
+                if is_resource_exhausted(e):
+                    self._note_oom("alloc", e)
+                raise
         new = self.allocator.allocate(need)
         start = len(seq.blocks)
         seq.blocks.extend(new)
@@ -3111,10 +3292,26 @@ class RaggedInferenceEngine:
         prefill (always >= 1 token, see ``_match_prefix``) produces the
         first token exactly as a cold prompt's final chunk would."""
         use_cache = self.cfg.enable_prefix_cache
+        headroom = -1
+        self._headroom_wait = False
+        if self._queued:
+            # measured free-byte headroom gates admission alongside the
+            # static block count; -1 (unknown backend) keeps the static
+            # path bit-identical. The prefix LRU sheds down to the same
+            # budget first so retention never starves admission.
+            headroom = self.admission_headroom_blocks()
+            if headroom >= 0:
+                self._enforce_retained_budget()
         while self._queued and self._free_slots:
             seq = self._queued[0]
             t_adm0 = time.perf_counter() if seq.trace is not None else 0.0
             worst = self._worst_case_blocks(seq)
+            if headroom >= 0 and worst > headroom:
+                # the device can't fund the worst case right now: wait for
+                # measured pressure to lift (flagged so the deadlock guard
+                # knows this stall is externally resolvable, not a livelock)
+                self._headroom_wait = True
+                break
             hit: list[int] = self._match_prefix(seq.prompt) if use_cache else []
             if hit:
                 # take the references first: free_blocks counts refcount-0
@@ -3145,6 +3342,10 @@ class RaggedInferenceEngine:
             seq.slot = self._free_slots.pop()
             seq.reserved_remaining = worst
             self._reserved += worst
+            if headroom >= 0:
+                # this admission will draw from the pool; clamp at 0 so the
+                # cap stays armed for the rest of the pass
+                headroom = max(0, headroom - worst)
             if hit:
                 seq.blocks = list(hit)
                 seq.cached_prefix = len(hit) * self.cfg.block_size
@@ -3247,6 +3448,16 @@ class RaggedInferenceEngine:
 
     def _deadlock_guard(self, n: int) -> None:
         if n == 0:
+            if self._headroom_wait:
+                # not a livelock: admission is pinned by measured device
+                # headroom, which another owner freeing bytes can lift —
+                # idle this tick instead of declaring deadlock
+                if self.telemetry.enabled:
+                    self.telemetry.counter(
+                        "kv_headroom_stalls_total",
+                        "scheduler ticks idled because measured free-byte "
+                        "headroom cannot fund any queued admission").inc()
+                return
             # has_work but nothing schedulable: every sequence is stalled on
             # KV-pool capacity and nothing can ever free a block — a silent
             # livelock without this guard. (The reference avoids this state
@@ -3366,7 +3577,8 @@ class RaggedInferenceEngine:
             try:
                 out = self._step_impl()
             except Exception as e:
-                if not classify_transient(e):
+                oom = is_resource_exhausted(e)
+                if not oom and not classify_transient(e):
                     raise
                 attempts += 1
                 self.step_failures += 1
@@ -3380,6 +3592,16 @@ class RaggedInferenceEngine:
                     f"ragged watchdog: transient step failure "
                     f"({type(e).__name__}: {e}); attempt {attempts}",
                     ranks=[0])
+                if oom:
+                    # OOM forensics: snapshot the ledger breakdown before
+                    # any recovery mutates it, then hand the ladder a hint —
+                    # retrying the exact same program into the exact same
+                    # full device is pointless, shedding device-resident
+                    # state is the move that frees bytes
+                    self._note_oom("dispatch", e)
+                    if cfg.degrade_after:
+                        self._consec_failures = max(
+                            self._consec_failures, cfg.degrade_after)
                 self._recover_device_path()
                 if self._maybe_degrade(e):
                     attempts = 0  # a fresh rung gets a fresh retry budget
@@ -3463,6 +3685,7 @@ class RaggedInferenceEngine:
         self.cache = self.spec.init_paged_cache_fn(
             self.cfg.num_blocks, self.cfg.block_size, self.dtype)
         self._consec_failures = 0
+        self._refresh_memory_handles()
         if failed:
             log_dist(
                 f"ragged engine: state reset failed {failed} in-flight "
@@ -3535,11 +3758,17 @@ class RaggedInferenceEngine:
             + len(self._chunk_keys) + len(self._step_keys))
         if self.cfg.enable_prefix_cache:
             alloc = self.allocator
+            bb = self._block_bytes()
             if alloc.evictions > self._evictions_seen:
+                delta = alloc.evictions - self._evictions_seen
                 tel.counter(
                     "prefix_cache_evictions_total",
                     "cached KV blocks reclaimed under pool pressure",
-                ).inc(alloc.evictions - self._evictions_seen)
+                ).inc(delta)
+                tel.counter(
+                    "prefix_cache_evicted_bytes_total",
+                    "HBM bytes reclaimed from the prefix cache",
+                ).inc(delta * bb)
                 self._evictions_seen = alloc.evictions
             g("prefix_cache_blocks_published",
               "KV blocks registered in the prefix index").set(
@@ -3547,10 +3776,18 @@ class RaggedInferenceEngine:
             g("prefix_cache_blocks_retained",
               "refcount-0 cached blocks held from the free list").set(
                   alloc.retained_blocks)
+            g("prefix_cache_retained_bytes",
+              "HBM bytes pinned by refcount-0 cached blocks").set(
+                  alloc.retained_blocks * bb)
             decided = self.prefix_hits + self.prefix_misses
             g("prefix_cache_hit_rate",
               "fraction of admissions with a cached prefix").set(
                   self.prefix_hits / decided if decided else 0.0)
+        hb = self.admission_headroom_blocks()
+        if hb >= 0:
+            g("kv_headroom_blocks",
+              "KV blocks fundable from measured free-byte headroom").set(hb)
+        tel.sample_memory(step=self.dispatch_count)
 
     def _step_impl(self) -> dict:
         self._sweep_aborts()

@@ -21,8 +21,18 @@ from deepspeed_tpu.utils.logging import log_dist
 
 def program_cost(fn, *args, **kwargs) -> dict:
     """FLOPs / bytes-accessed / peak-memory of ``jit(fn)(*args)`` from XLA's
-    cost model. Returns {} keys that the backend doesn't report."""
+    cost model. Returns {} keys that the backend doesn't report. When a
+    memory ledger is configured the compiled program's temp/argument/output
+    footprint is also recorded under its function name."""
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    try:
+        from deepspeed_tpu.telemetry import get_telemetry
+
+        led = get_telemetry().memledger
+        if led is not None:
+            led.note_program(getattr(fn, "__name__", "program"), compiled)
+    except Exception:
+        pass
     analyses = compiled.cost_analysis()
     analysis = analyses[0] if isinstance(analyses, (list, tuple)) else analyses
     out = {}

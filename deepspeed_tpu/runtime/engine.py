@@ -1636,31 +1636,54 @@ class Engine:
         # all_reduce -> compressed_allreduce handoff at freeze_step)
         in_dense_phase = (self._qgrad
                           and self.global_steps < self._qgrad_warmup_steps)
-        if in_dense_phase:
-            if self._warm_batch_jit is None:
-                self._warm_batch_jit = self._build_train_batch_fn(
-                    use_qgrad=False)
-            self.params, self.opt_state, self.scale_state, metrics = \
-                self._warm_batch_jit(
+        try:
+            if in_dense_phase:
+                if self._warm_batch_jit is None:
+                    self._warm_batch_jit = self._build_train_batch_fn(
+                        use_qgrad=False)
+                self.params, self.opt_state, self.scale_state, metrics = \
+                    self._warm_batch_jit(
+                        self.params, self.opt_state, self.scale_state,
+                        jnp.int32(self.global_steps), self._train_rng,
+                        dev_batch,
+                    )
+            elif self._qgrad:
+                (self.params, self.opt_state, self.scale_state, metrics,
+                 self._qgrad_error) = self._train_batch_jit(
                     self.params, self.opt_state, self.scale_state,
                     jnp.int32(self.global_steps), self._train_rng, dev_batch,
+                    self._qgrad_error,
                 )
-        elif self._qgrad:
-            (self.params, self.opt_state, self.scale_state, metrics,
-             self._qgrad_error) = self._train_batch_jit(
-                self.params, self.opt_state, self.scale_state,
-                jnp.int32(self.global_steps), self._train_rng, dev_batch,
-                self._qgrad_error,
-            )
-        else:
-            self.params, self.opt_state, self.scale_state, metrics = self._train_batch_jit(
-                self.params,
-                self.opt_state,
-                self.scale_state,
-                jnp.int32(self.global_steps),
-                self._train_rng,
-                dev_batch,
-            )
+            else:
+                self.params, self.opt_state, self.scale_state, metrics = \
+                    self._train_batch_jit(
+                        self.params,
+                        self.opt_state,
+                        self.scale_state,
+                        jnp.int32(self.global_steps),
+                        self._train_rng,
+                        dev_batch,
+                    )
+        except Exception as e:
+            # OOM forensics: a RESOURCE_EXHAUSTED dispatch writes the
+            # per-owner crash report BEFORE unwinding (the ledger breakdown
+            # at the failure instant is the evidence); the error itself
+            # still escalates — training has no degradation ladder
+            from deepspeed_tpu.telemetry.memledger import (
+                is_resource_exhausted, record_oom)
+
+            if is_resource_exhausted(e) \
+                    and not getattr(e, "_oom_recorded", False):
+                try:
+                    e._oom_recorded = True
+                except Exception:
+                    pass
+                record_oom("train", e, context={
+                    "global_steps": self.global_steps,
+                    "micro_steps": self.micro_steps,
+                    "gas": self.gas,
+                })
+            raise
         # NO per-step device sync here: over a tunneled TPU each host<->device
         # round trip costs more than the update tail; steps pipeline and Python
         # overhead hides under device compute. _after_step syncs only when a
@@ -1931,7 +1954,41 @@ class Engine:
                         "fp16 overflow-skipped steps").inc()
             tel.event("train/overflow", step=step,
                       loss_scale=attrs.get("loss_scale"))
+        self._register_memory_owners(tel)
         tel.sample_memory(step=step)
+
+    def _register_memory_owners(self, tel) -> None:
+        """Attribute params/optimizer/grad-buffer bytes to the memory
+        ledger. Lazy (first telemetry-enabled step) because telemetry is
+        often configured after engine construction; re-registration is a
+        no-op via the handle cache."""
+        led = tel.memledger
+        if led is None or getattr(self, "_memledger_handles", None):
+            return
+        h = {"params": led.register("params", "engine/model_params",
+                                    self.params)}
+        if self.opt_state is not None:
+            h["optimizer_shards"] = led.register(
+                "optimizer_shards", "engine/opt_state", self.opt_state)
+        self._memledger_handles = h
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _grad_bytes():
+            eng = ref()
+            if eng is None:
+                return None
+            from deepspeed_tpu.telemetry.memledger import tree_nbytes
+
+            total = 0
+            for acc in (getattr(eng, "_acc_grads", None),
+                        getattr(eng, "_zf_acc", None)):
+                if acc is not None:
+                    total += tree_nbytes(acc)
+            return total
+
+        led.register_provider("grads", "engine/grad_accum", _grad_bytes)
 
     # ------------------------------------------------------------------ checkpoint
     def _rng_state_dict(self) -> dict:
@@ -2030,29 +2087,46 @@ class Engine:
         else:
             opt_payload = sharded.collect_fragments(self.opt_state, "optimizer")
 
+        # the host double buffer is real memory for the collect→flush window:
+        # attribute it to the ledger so an OOM during an async save shows the
+        # snapshot bytes instead of an unattributed spike
+        led = self.telemetry.memledger
+        stage_handle = None
+        if led is not None:
+            from deepspeed_tpu.telemetry.memledger import tree_nbytes
+
+            stage_handle = led.register(
+                "staging_buffers", f"ckpt/{tag}/host_snapshot",
+                tree_nbytes(model_payload[0]) + tree_nbytes(opt_payload[0]))
+
         def flush():
             import jax as _jax
 
-            # phase 1 (prepare): everything goes into the staging dir
-            inj.fire(_faults.POINT_CKPT_FLUSH)
-            sharded.write_fragments(stage_dir, "model", *model_payload)
-            inj.fire(_faults.POINT_CKPT_FLUSH, path=os.path.join(
-                stage_dir, f"model_shard_p{_jax.process_index()}.npz"))
-            sharded.write_fragments(stage_dir, "optimizer", *opt_payload)
-            inj.fire(_faults.POINT_CKPT_FLUSH, path=os.path.join(
-                stage_dir, f"optimizer_shard_p{_jax.process_index()}.npz"))
-            dist.barrier("save_checkpoint")
-            if _jax.process_index() == 0:
-                sharded.finalize_index(stage_dir, "model")
-                sharded.finalize_index(stage_dir, "optimizer")
-                # phase 2 (commit): checksum + manifest + atomic promote
-                ckpt_dir = ckpt.commit_checkpoint(save_dir, str(tag), manifest)
-                if save_latest:
-                    ckpt.write_latest(save_dir, str(tag))
-                ckpt.rotate_checkpoints(
-                    save_dir, self.config.checkpoint.keep_n_latest,
-                    protect=str(tag))
-                log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+            try:
+                # phase 1 (prepare): everything goes into the staging dir
+                inj.fire(_faults.POINT_CKPT_FLUSH)
+                sharded.write_fragments(stage_dir, "model", *model_payload)
+                inj.fire(_faults.POINT_CKPT_FLUSH, path=os.path.join(
+                    stage_dir, f"model_shard_p{_jax.process_index()}.npz"))
+                sharded.write_fragments(stage_dir, "optimizer", *opt_payload)
+                inj.fire(_faults.POINT_CKPT_FLUSH, path=os.path.join(
+                    stage_dir, f"optimizer_shard_p{_jax.process_index()}.npz"))
+                dist.barrier("save_checkpoint")
+                if _jax.process_index() == 0:
+                    sharded.finalize_index(stage_dir, "model")
+                    sharded.finalize_index(stage_dir, "optimizer")
+                    # phase 2 (commit): checksum + manifest + atomic promote
+                    ckpt_dir = ckpt.commit_checkpoint(
+                        save_dir, str(tag), manifest)
+                    if save_latest:
+                        ckpt.write_latest(save_dir, str(tag))
+                    ckpt.rotate_checkpoints(
+                        save_dir, self.config.checkpoint.keep_n_latest,
+                        protect=str(tag))
+                    log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+            finally:
+                if stage_handle is not None:
+                    led.release(stage_handle)
 
         self._join_ckpt_writer()
         import jax as _jax

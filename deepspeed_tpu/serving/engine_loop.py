@@ -140,6 +140,9 @@ class ReplicaStats:
     dispatches_per_token: float = 1.0
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # measured free-byte headroom expressed in KV blocks (-1 = backend does
+    # not report memory limits; routers fall back to the static block math)
+    headroom_blocks: int = -1
 
     def worst_blocks(self, total_tokens: int) -> int:
         return -(-total_tokens // self.block_size)
@@ -393,7 +396,9 @@ class EngineLoop:
                 getattr(self._engine, "dispatch_count", 0)
                 / max(getattr(self._engine, "tokens_emitted", 0), 1)),
             spec_proposed=int(getattr(self._engine, "spec_proposed", 0)),
-            spec_accepted=int(getattr(self._engine, "spec_accepted", 0)))
+            spec_accepted=int(getattr(self._engine, "spec_accepted", 0)),
+            headroom_blocks=int(getattr(
+                self._engine, "admission_headroom_blocks", lambda: -1)()))
 
     # ------------------------------------------------------- loop internals
     def _drain_inbox(self) -> None:

@@ -27,6 +27,10 @@ Fault kinds:
 - ``kill`` — ``SIGKILL`` the calling process at the seam (the train-chaos
   harness's mid-flush / mid-commit kills; nothing downstream of the seam
   runs, exactly like a preemption landing there).
+- ``oom`` — raise a ``RESOURCE_EXHAUSTED``-worded :class:`FaultError`
+  (models the XLA allocator failing a device allocation; the memory
+  ledger's OOM forensics and the watchdog's degradation hint key on the
+  status text, exactly as they would for a real PJRT OOM).
 
 ``classify_transient`` is the shared error taxonomy used by the dispatch
 watchdog (inference/ragged.py) and the router breaker: injected transient
@@ -119,7 +123,7 @@ class FaultSpec:
             raise ValueError(
                 f"unknown fault point {self.point!r} (known: {POINTS})")
         if self.kind not in ("raise", "hang", "latency", "truncate",
-                             "corrupt-bytes", "kill"):
+                             "corrupt-bytes", "kill", "oom"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
 
 
@@ -236,6 +240,14 @@ class FaultInjector:
         if spec.kind == "hang":
             time.sleep(spec.delay_s)
             raise TimeoutError(msg)
+        if spec.kind == "oom":
+            # worded like a real PJRT allocation failure so every layer
+            # (is_resource_exhausted, OOM forensics, degradation hint)
+            # treats it exactly like one
+            raise FaultError(
+                spec.message or (
+                    f"RESOURCE_EXHAUSTED: injected out-of-memory at {point} "
+                    f"(hit {spec.hits}, firing {spec.fired})"), point)
         if spec.fatal:
             raise FatalFaultError(msg, point)
         raise FaultError(msg, point)

@@ -23,6 +23,11 @@ Endpoints:
 - ``GET /debug/trace`` — the request-trace span ring as Chrome
   trace-event JSON (load in Perfetto); ``?trace_id=<32hex>`` filters to
   one trace.
+- ``GET /debug/memory`` — the memory ledger's live picture: per-owner
+  byte breakdown, a fresh ``jax.live_arrays()`` census (attributed vs
+  unattributed bytes), per-program temp footprints, device allocator
+  stats, and any OOM crash reports written this process. ``{"enabled":
+  false}`` when no ledger is configured.
 
 Tracing: ``POST /v1/completions`` honors an incoming W3C ``traceparent``
 header (or head-samples a fresh trace when the tracer is enabled); the
@@ -182,6 +187,12 @@ def _make_handler(frontend: ServingFrontend):
                 trace_id = (parse_qs(query).get("trace_id") or [None])[0]
                 self._send_json(
                     200, get_telemetry().export_chrome_trace(trace_id))
+            elif path == "/debug/memory":
+                led = get_telemetry().memledger
+                if led is None:
+                    self._send_json(200, {"enabled": False})
+                else:
+                    self._send_json(200, led.debug_payload())
             else:
                 self._send_error_json(404, f"no route for {path}")
 

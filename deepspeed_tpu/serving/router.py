@@ -145,9 +145,18 @@ def plan_placement(
     def load(i: int, s: ReplicaStats) -> int:
         return s.outstanding_tokens + total_tokens - cached(i)
 
+    def cap(s: ReplicaStats) -> int:
+        # admit-now capacity: static free-block math, further capped by the
+        # replica's measured free-byte headroom when the backend reports it
+        # (headroom_blocks == -1 keeps the static path bit-identical)
+        free = s.free_blocks - s.pending_blocks
+        if s.headroom_blocks >= 0:
+            free = min(free, s.headroom_blocks - s.pending_blocks)
+        return free
+
     fits_now = [
         (i, s) for i, s in live
-        if need(i, s) <= s.free_blocks - s.pending_blocks
+        if need(i, s) <= cap(s)
         and load(i, s) <= cfg.max_queue_tokens
     ]
     if fits_now:
@@ -453,6 +462,13 @@ class ReplicaRouter:
             sum(s.free_blocks for s in stats))
         tel.gauge("serving_kv_pending_blocks").set(
             sum(s.pending_blocks for s in stats))
+        known = [s.headroom_blocks for s in stats if s.headroom_blocks >= 0]
+        if known:
+            tel.gauge(
+                "serving_kv_headroom_blocks",
+                "KV blocks fundable from measured free-byte headroom "
+                "(replicas whose backend reports memory limits)",
+            ).set(sum(known))
         tel.gauge("serving_draining").set(1.0 if self._draining else 0.0)
         breaker_rank = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
         for r, s, h in zip(replicas, stats, health):

@@ -22,7 +22,15 @@ block; ``deepspeed_tpu.initialize`` wires the engine emit points.
 """
 
 from deepspeed_tpu.telemetry.core import TELEMETRY, Telemetry  # noqa: F401
+from deepspeed_tpu.telemetry.memledger import (  # noqa: F401
+    MemoryLedger,
+    OWNERS as MEMORY_OWNERS,
+    is_resource_exhausted,
+    record_oom,
+    tree_nbytes,
+)
 from deepspeed_tpu.telemetry.registry import (  # noqa: F401
+    BYTE_BUCKETS,
     Counter,
     Gauge,
     Histogram,

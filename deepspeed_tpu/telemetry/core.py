@@ -49,6 +49,7 @@ class Telemetry:
         self.tracer = Tracer(self.registry)
         self._slo = None
         self._compile_watch = None
+        self._memledger = None
         self._sinks: list = []
         self._prometheus = None
         self._sampler = None
@@ -134,12 +135,27 @@ class Telemetry:
                 from deepspeed_tpu.telemetry.compile_watch import CompileWatch
 
                 self._compile_watch = CompileWatch(self.registry).install()
+            ml = opts.get("memledger") or {}
+            if ml is True:
+                ml = {"enabled": True}
+            if ml.get("enabled"):
+                from deepspeed_tpu.telemetry.memledger import MemoryLedger
+
+                self._memledger = MemoryLedger(
+                    self,
+                    census_interval_steps=int(
+                        ml.get("census_interval_steps", 50)),
+                    drift_threshold=float(ml.get("drift_threshold", 0.05)),
+                    drift_consecutive=int(ml.get("drift_consecutive", 3)),
+                    report_dir=str(ml.get("report_dir", "oom_reports")),
+                )
         self.event("telemetry/configured",
                    sinks=[type(s).__name__ for s in self._sinks],
                    prometheus_port=(self._prometheus.port
                                     if self._prometheus else None),
                    tracing=self.tracer.enabled,
-                   slo=self._slo is not None)
+                   slo=self._slo is not None,
+                   memledger=self._memledger is not None)
         return self
 
     @property
@@ -207,13 +223,24 @@ class Telemetry:
 
     def sample_memory(self, step: int | None = None) -> dict:
         """Per-step HBM watermark gauges (no device sync)."""
-        if not self.enabled or not self._hbm_watermarks:
+        if not self.enabled:
+            return {}
+        led = self._memledger
+        if led is not None:
+            led.maybe_census(step)
+        if not self._hbm_watermarks:
             return {}
         if self._sampler is None:
             from deepspeed_tpu.telemetry.memory import HbmWatermarkSampler
 
             self._sampler = HbmWatermarkSampler(self)
         return self._sampler.sample(step)
+
+    @property
+    def memledger(self):
+        """The configured :class:`MemoryLedger`, or None (hot paths guard
+        on this one attribute read — off means zero allocations)."""
+        return self._memledger
 
     # ------------------------------------------------------------- tracing
     def export_chrome_trace(self, trace_id: str | None = None) -> dict:
@@ -310,6 +337,7 @@ class Telemetry:
                 pass
             self._prometheus = None
         self._sampler = None
+        self._memledger = None
         self._since_flush = 0
         self.tracer.reset()
         self._slo = None

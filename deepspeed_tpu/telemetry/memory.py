@@ -1,9 +1,20 @@
 """Per-step HBM watermark sampler backed by ``accelerator.memory_stats()``.
 
 On TPU the stats come from ``device.memory_stats()`` (bytes_in_use /
-bytes_limit / peak_bytes_in_use); the CPU test accelerator reports ru_maxrss.
-Sampling is a host-side dict read — it never syncs the device — so it is safe
-to run every step while the async dispatch pipeline is in flight.
+bytes_limit / peak_bytes_in_use, plus allocator extras like bytes_reserved
+and largest_free_block_bytes where the backend reports them); the CPU test
+accelerator reports ru_maxrss. Sampling is a host-side dict read — it never
+syncs the device — so it is safe to run every step while the async dispatch
+pipeline is in flight.
+
+Gauges come in two shapes: the legacy unlabeled aggregates (device 0 /
+process, kept for dashboard continuity) and per-device labeled series
+(``hbm_device_bytes_in_use{device=}`` ...) so a multi-chip host shows which
+chip is actually under pressure — a device-0-only watermark hides an OOM
+brewing on device 3. ``hbm_fragmentation_bytes`` (bytes_reserved −
+bytes_in_use) and ``hbm_largest_free_block_bytes`` surface allocator shape:
+plenty of free bytes with a small largest-free-block is exactly the state
+where a big KV allocation still fails.
 """
 
 from __future__ import annotations
@@ -13,6 +24,7 @@ class HbmWatermarkSampler:
     """Reads accelerator memory stats into gauges + one JSONL gauge record."""
 
     GAUGES = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+    EXTRA_GAUGES = ("bytes_reserved", "largest_free_block_bytes")
 
     def __init__(self, telemetry):
         self._telemetry = telemetry
@@ -27,7 +39,8 @@ class HbmWatermarkSampler:
 
             self._accelerator = get_accelerator()
         try:
-            stats = self._accelerator.memory_stats() or {}
+            per_device = self._accelerator.memory_stats_all_devices() or []
+            stats = per_device[0] if per_device else {}
         except Exception:
             # a backend without memory stats must not take down training
             self._broken = True
@@ -41,6 +54,30 @@ class HbmWatermarkSampler:
                 value = float(stats[key])
                 tel.gauge(f"hbm_{key}", "accelerator memory watermark").set(value)
                 record[key] = value
+        # per-device labeled series + allocator-shape gauges (only where
+        # the backend reports them — absent keys emit nothing, preserving
+        # the no-stats-backend silence guarantee above)
+        for idx, dev in enumerate(per_device):
+            label = str(idx)
+            for key in self.GAUGES:
+                if key in dev:
+                    tel.gauge(
+                        f"hbm_device_{key}",
+                        "per-device accelerator memory watermark",
+                    ).set(float(dev[key]), device=label)
+            if "bytes_reserved" in dev and "bytes_in_use" in dev:
+                tel.gauge(
+                    "hbm_fragmentation_bytes",
+                    "allocator bytes reserved but not in use (bytes_reserved"
+                    " - bytes_in_use)",
+                ).set(float(dev["bytes_reserved"]) - float(dev["bytes_in_use"]),
+                      device=label)
+            if "largest_free_block_bytes" in dev:
+                tel.gauge(
+                    "hbm_largest_free_block_bytes",
+                    "largest single allocation the backend allocator can "
+                    "still satisfy",
+                ).set(float(dev["largest_free_block_bytes"]), device=label)
         if "bytes_in_use" in record:
             # MonitorSink plots records with a scalar `value`
             record["value"] = record["bytes_in_use"]

@@ -24,6 +24,12 @@ DEFAULT_BUCKETS = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
 )
 
+# pow2 byte-scale buckets for ``*_bytes`` histograms (1 KiB .. 64 GiB):
+# staging uploads, KV transfers, checkpoint fragments, OOM-adjacent
+# allocation sizes — the seconds-scale defaults would collapse every
+# observation into +Inf
+BYTE_BUCKETS = tuple(float(2 ** p) for p in range(10, 37, 2))
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")
 

@@ -11,8 +11,11 @@ consumers at once:
   loadable in Perfetto.
 - **Metrics registry**: ``step_phase_seconds{phase=}`` histograms,
   ``train_overlap_fraction`` / ``train_goodput`` / ``train_mfu`` /
-  ``train_phase_mfu{phase=}`` / ``train_step_skew_ratio`` gauges, and
-  ``train_goodput_seconds_total{category=}`` counters.
+  ``train_phase_mfu{phase=}`` / ``train_step_skew_ratio`` gauges,
+  ``train_goodput_seconds_total{category=}`` counters, and per-phase HBM
+  watermark deltas (``step_phase_hbm_delta_bytes{phase=}`` +
+  ``step_hbm_peak_bytes{phase=}`` naming the phase that owns the step's
+  memory peak — the when-complement to the memory ledger's who).
 - **bench.py --mode train-anatomy**: :meth:`StepScope.summary` is the JSON
   payload.
 
@@ -133,6 +136,11 @@ class StepScope:
         self._step_t0: float | None = None
         self._marks: list[tuple[str, float, float]] = []
         self._c0_compile = 0.0
+        # per-phase HBM watermarks (host-side dict reads, no device sync);
+        # a backend without memory stats flips _mem_broken and the feature
+        # goes permanently silent, like HbmWatermarkSampler
+        self._mem_broken = False
+        self._mem_marks: list[tuple[str, int]] = []
 
         # run accumulators (summary() + gauges)
         self._steps = 0
@@ -154,6 +162,7 @@ class StepScope:
         self._c_goodput = None
         self._g_overlap = self._g_goodput = self._g_skew = None
         self._g_mfu = self._g_phase_mfu = None
+        self._g_phase_hbm = self._g_peak_hbm = None
         if self.enabled:
             reg = telemetry.registry
             self._phase_hist = reg.histogram(
@@ -182,6 +191,14 @@ class StepScope:
             self._g_phase_mfu = reg.gauge(
                 "train_phase_mfu",
                 "per-phase achieved/roofline FLOPs (attributed phases)")
+            self._g_phase_hbm = reg.gauge(
+                "step_phase_hbm_delta_bytes",
+                "HBM watermark delta across each host-measured phase "
+                "(which phase grows device memory)")
+            self._g_peak_hbm = reg.gauge(
+                "step_hbm_peak_bytes",
+                "step's highest HBM watermark, labeled by the phase whose "
+                "boundary observed it (which phase owns the peak)")
             # pre-set so a scrape sees the series before the first step
             self._g_overlap.set(1.0)
             self._g_goodput.set(0.0)
@@ -202,12 +219,37 @@ class StepScope:
         self._step_t0 = now
         self._marks = []
         self._c0_compile = self._compile_hist.sum(phase="backend_compile")
+        self._mem_marks = []
+        m = self._read_mem()
+        if m >= 0:
+            self._mem_marks.append(("begin", m))
 
     def note_phase(self, name: str, t0: float, t1: float) -> None:
         """Record a host-measured phase window (perf_counter stamps)."""
         if not self.enabled or self._step_t0 is None:
             return
         self._marks.append((name, t0, max(t0, t1)))
+        m = self._read_mem()
+        if m >= 0:
+            self._mem_marks.append((name, m))
+
+    def _read_mem(self) -> int:
+        """Current HBM bytes_in_use, or -1 when the backend reports none
+        (one failed probe disables the feature for the run)."""
+        if self._mem_broken:
+            return -1
+        try:
+            from deepspeed_tpu.accelerator.real_accelerator import (
+                get_accelerator,
+            )
+
+            v = (get_accelerator().memory_stats() or {}).get("bytes_in_use")
+        except Exception:
+            v = None
+        if v is None:
+            self._mem_broken = True
+            return -1
+        return int(v)
 
     @contextmanager
     def phase(self, name: str):
@@ -289,6 +331,20 @@ class StepScope:
                                  step_ctx.span_id),
                     f"train/phase/{name}", a, b, phase=name,
                     attributed=True if attributed else None)
+
+        # per-phase HBM watermark deltas: each boundary sample is charged to
+        # the phase that just ended, and the step's highest watermark names
+        # the phase that owns the peak (the memory-ledger complement: the
+        # ledger says WHO holds the bytes, this says WHEN they appear)
+        if len(self._mem_marks) >= 2:
+            prev = self._mem_marks[0][1]
+            peak_phase, peak_bytes = self._mem_marks[0]
+            for name, m in self._mem_marks[1:]:
+                self._g_phase_hbm.set(float(m - prev), phase=name)
+                if m > peak_bytes:
+                    peak_phase, peak_bytes = name, m
+                prev = m
+            self._g_peak_hbm.set(float(peak_bytes), phase=peak_phase)
 
         # goodput: a recompiling step is productive only for its non-compile
         # remainder

@@ -108,6 +108,9 @@ class Tracer:
         self.registry = registry
         self.sample_rate = 1.0
         self._ring: deque = deque(maxlen=4096)
+        # Perfetto counter-track samples (ph "C" on export): bounded like
+        # the span ring so a forgotten tracer can never grow without limit
+        self._counters: deque = deque(maxlen=4096)
         self._lock = threading.Lock()
         self._sample_n = 0
         # perf_counter <-> wall-clock anchor for export timestamps
@@ -133,6 +136,7 @@ class Tracer:
             self.enabled = False
             self.sample_rate = 1.0
             self._ring.clear()
+            self._counters.clear()
             self._sample_n = 0
 
     # -------------------------------------------------------------- context
@@ -216,6 +220,19 @@ class Tracer:
         finally:
             self.finish(ctx, name, t0, time.perf_counter(), **attrs)
 
+    def counter_sample(self, track: str, values: dict,
+                       t: float | None = None) -> None:
+        """Record one sample on a Perfetto counter track (memory_bytes per
+        owner, KV occupancy, ...). Exported as a ``ph: "C"`` event so the
+        trace UI draws a stacked area chart alongside the span tracks."""
+        if not self.enabled or not values:
+            return
+        self._counters.append({
+            "track": track,
+            "t": time.perf_counter() if t is None else t,
+            "values": {str(k): float(v) for k, v in values.items()},
+        })
+
     # -------------------------------------------------------------- export
     def snapshot(self, trace_id: str | None = None) -> list[dict]:
         """Finished spans currently in the ring (oldest first), optionally
@@ -243,6 +260,12 @@ class Tracer:
                 "ts": (s["t0"] - self._epoch_pc) * 1e6,
                 "dur": s["dur_s"] * 1e6,
                 "pid": pid, "tid": s["tid"], "args": args,
+            })
+        for c in list(self._counters):
+            events.append({
+                "name": c["track"], "ph": "C", "cat": "memory",
+                "ts": (c["t"] - self._epoch_pc) * 1e6,
+                "pid": pid, "args": c["values"],
             })
         return {
             "traceEvents": events,
