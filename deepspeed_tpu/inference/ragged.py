@@ -275,15 +275,25 @@ class RaggedConfig:
     # purely by free memory. Off by default: disabled, scheduling behavior
     # is bit-identical to an uncached engine.
     enable_prefix_cache: bool = False
-    # headroom-driven admission (telemetry/memledger.py): cap admission and
-    # the prefix-cache LRU by MEASURED free-byte headroom instead of static
-    # block counts. A backend that reports no bytes_limit (the CPU test
-    # accelerator) yields "unknown" headroom and the static path verbatim,
-    # so default behavior is bit-identical off-TPU.
-    headroom_admission: bool = True
+    # headroom-driven admission (telemetry/memledger.py): cap admission by
+    # MEASURED free-byte headroom alongside the static block count. The KV
+    # pool is preallocated at init, so its free blocks are credited as
+    # already-funded bytes — the gate only bites when OTHER owners
+    # (checkpoint staging, compile temps, co-located jobs) have eaten the
+    # device's guard band beyond what the pool itself could fund. Opt-in:
+    # admission from a preallocated pool allocates no new device bytes, so
+    # most deployments want the static path; a backend that reports no
+    # bytes_limit (the CPU test accelerator) yields "unknown" headroom and
+    # the static path verbatim either way.
+    headroom_admission: bool = False
     # fraction of bytes_limit held back from the measured free bytes before
     # converting headroom to KV blocks (allocator slack + fragmentation)
     headroom_guard_fraction: float = 0.05
+    # consecutive zero-progress scheduler ticks spent headroom-pinned before
+    # the stall alarm raises (a headroom wait must never be a silent forever
+    # hang — external pressure is expected to lift, and when it doesn't the
+    # operator needs a loud failure, not an idle loop). 0 disables the alarm.
+    headroom_stall_alarm_ticks: int = 1000
 
     @property
     def max_seq_len(self) -> int:
@@ -685,6 +695,7 @@ class RaggedInferenceEngine:
         self._mem_stats_fn: Callable | None = None  # test hook: fake stats
         self._memledger_handles: dict | None = None
         self._headroom_wait = False  # admission pinned by measured headroom
+        self._headroom_stall_ticks = 0  # consecutive zero-progress waits
         self.last_oom_report: str | None = None
         self._register_memory_owners()
         log_dist(
@@ -933,9 +944,13 @@ class RaggedInferenceEngine:
         """Attribute this engine's long-lived device allocations to ledger
         owners. Providers close over a weakref so a retired engine is never
         pinned by the process-wide ledger (a dead ref returns None, which
-        the ledger prunes)."""
+        the ledger prunes). Called at construction AND retried from the
+        per-step telemetry hook: telemetry is often configured after the
+        engine is built (the training engine has the same lazy pattern),
+        and an engine that never registers would make every census read
+        ~100% unattributed. The handle cache makes re-entry a no-op."""
         led = self.telemetry.memledger
-        if led is None:
+        if led is None or self._memledger_handles is not None:
             return
         h = {
             "params": led.register("params", "ragged/model_params",
@@ -973,10 +988,14 @@ class RaggedInferenceEngine:
 
         led.register_provider("staging_buffers", "ragged/staging_cache",
                               _staging_bytes)
+        # retained prefix blocks and parked handoff blocks live INSIDE the
+        # kv_pool arrays registered above — carve-outs, so the breakdown
+        # shows them as their own owners while the attributed total still
+        # counts each pool byte exactly once
         led.register_provider("prefix_cache_retained", "ragged/prefix_lru",
-                              _prefix_retained_bytes)
+                              _prefix_retained_bytes, carveout_of="kv_pool")
         led.register_provider("kv_handoff", "ragged/parked_handoffs",
-                              _handoff_bytes)
+                              _handoff_bytes, carveout_of="kv_pool")
 
     def _refresh_memory_handles(self) -> None:
         """Re-measure ledger handles after crash containment rebuilt the
@@ -1029,10 +1048,14 @@ class RaggedInferenceEngine:
             return {}
 
     def admission_headroom_blocks(self) -> int:
-        """MEASURED free-byte headroom expressed in KV blocks: how many
-        block-sized allocations the device could actually fund right now,
-        after a guard band. -1 = unknown (no ``bytes_limit`` reported, or
-        headroom admission disabled) — callers must fall back to the static
+        """MEASURED free-byte headroom expressed in KV blocks, net of the
+        pool's own preallocated footprint: the pool's allocatable blocks
+        (free list + evictable prefix LRU) are bytes the device already
+        funds, so admission drawing from them consumes no new HBM and must
+        never be gated by a full-looking device. Only a deficit beyond what
+        the pool could fund — other owners eating the guard band — shrinks
+        the answer. -1 = unknown (no ``bytes_limit`` reported, or headroom
+        admission disabled) — callers must fall back to the static
         block-count path, bit-identically."""
         cfg = self.cfg
         if not cfg.headroom_admission:
@@ -1041,23 +1064,31 @@ class RaggedInferenceEngine:
         limit = int(stats.get("bytes_limit") or 0)
         if limit <= 0:
             return -1
+        bb = max(1, self._block_bytes())
         free = limit - int(stats.get("bytes_in_use") or 0)
-        usable = free - int(cfg.headroom_guard_fraction * limit)
-        return max(0, usable // max(1, self._block_bytes()))
+        pool_funded = self.allocator.free_blocks * bb
+        usable = free + pool_funded - int(cfg.headroom_guard_fraction * limit)
+        return max(0, usable // bb)
 
     def _enforce_retained_budget(self) -> int:
-        """Re-derive the prefix-cache LRU budget from measured headroom:
-        retention may hold at most as many blocks as the device could fund
-        again. Unknown headroom (or ample headroom) leaves the LRU
-        untouched — static-path parity."""
-        hb = self.admission_headroom_blocks()
-        if hb < 0:
+        """Shed the prefix-cache LRU under POOL-level pressure: retention
+        may hold only what outstanding reservations don't need, i.e. evict
+        until the free list alone covers ``self._reserved``. Deliberately
+        not a device-byte budget — evicting a retained block returns it to
+        the preallocated pool's free list and frees zero HBM, so a
+        measured-byte budget here would wipe the cache on a full device
+        without recovering anything. When reservations already fit the free
+        list this is a no-op (static-path parity)."""
+        alloc = self.allocator
+        budget = alloc.free_blocks - self._reserved
+        if budget >= alloc.retained_blocks:
             return 0
-        evicted = self.allocator.shrink_retained(hb)
+        evicted = alloc.shrink_retained(budget)
         if evicted and self.telemetry.enabled:
             self.telemetry.counter(
                 "prefix_cache_headroom_evictions_total",
-                "cached blocks evicted by the headroom-driven LRU budget",
+                "cached blocks evicted so the pool free list covers "
+                "outstanding admission reservations",
             ).inc(evicted)
         return evicted
 
@@ -3295,10 +3326,12 @@ class RaggedInferenceEngine:
         headroom = -1
         self._headroom_wait = False
         if self._queued:
-            # measured free-byte headroom gates admission alongside the
-            # static block count; -1 (unknown backend) keeps the static
-            # path bit-identical. The prefix LRU sheds down to the same
-            # budget first so retention never starves admission.
+            # measured free-byte headroom (net of the pool's preallocated
+            # footprint — pool-funded blocks are never gated) rides
+            # alongside the static block count; -1 (unknown backend or
+            # knob off) keeps the static path bit-identical. The prefix
+            # LRU sheds under pool pressure first so retention never
+            # starves admission's reservations.
             headroom = self.admission_headroom_blocks()
             if headroom >= 0:
                 self._enforce_retained_budget()
@@ -3307,9 +3340,11 @@ class RaggedInferenceEngine:
             t_adm0 = time.perf_counter() if seq.trace is not None else 0.0
             worst = self._worst_case_blocks(seq)
             if headroom >= 0 and worst > headroom:
-                # the device can't fund the worst case right now: wait for
-                # measured pressure to lift (flagged so the deadlock guard
-                # knows this stall is externally resolvable, not a livelock)
+                # even counting the pool's own allocatable blocks the
+                # device can't fund the worst case: external HBM pressure.
+                # Wait for it to lift (flagged so the deadlock guard knows
+                # this stall is externally resolvable, not a livelock —
+                # and starts the stall-duration alarm clock)
                 self._headroom_wait = True
                 break
             hit: list[int] = self._match_prefix(seq.prompt) if use_cache else []
@@ -3386,6 +3421,10 @@ class RaggedInferenceEngine:
                               t_adm0, seq.t_admit, slot=seq.slot,
                               blocks_reserved=seq.reserved_remaining,
                               cached_prefix_tokens=seq.cached_prefix or None)
+        if not self._headroom_wait:
+            # pass ended unpinned (admitted, empty queue, or plain pool
+            # pressure): the stall-duration alarm clock rearms
+            self._headroom_stall_ticks = 0
 
     def _emit_tokens(self, logits, emit) -> dict:
         """Shared step epilogue: pick at the emit indices (greedy, or the
@@ -3447,16 +3486,38 @@ class RaggedInferenceEngine:
         return out
 
     def _deadlock_guard(self, n: int) -> None:
+        if n > 0:
+            self._headroom_stall_ticks = 0
+            return
         if n == 0:
             if self._headroom_wait:
                 # not a livelock: admission is pinned by measured device
                 # headroom, which another owner freeing bytes can lift —
-                # idle this tick instead of declaring deadlock
+                # idle this tick instead of declaring deadlock. But a wait
+                # that never lifts must not become a silent forever-hang:
+                # after headroom_stall_alarm_ticks consecutive idle ticks
+                # the stall alarm raises with the measured picture.
+                self._headroom_stall_ticks += 1
                 if self.telemetry.enabled:
                     self.telemetry.counter(
                         "kv_headroom_stalls_total",
                         "scheduler ticks idled because measured free-byte "
                         "headroom cannot fund any queued admission").inc()
+                alarm = self.cfg.headroom_stall_alarm_ticks
+                if alarm and self._headroom_stall_ticks >= alarm:
+                    stats = self._device_memory_stats()
+                    raise RuntimeError(
+                        "headroom admission stalled: measured free-byte "
+                        f"headroom funded no admission for {alarm} "
+                        "consecutive scheduler ticks "
+                        f"(queued={len(self._queued)} "
+                        f"free_blocks={self.allocator.free_blocks} "
+                        f"bytes_in_use={stats.get('bytes_in_use')} "
+                        f"bytes_limit={stats.get('bytes_limit')}); another "
+                        "HBM owner is pinning the device — lower "
+                        "headroom_guard_fraction, free the external "
+                        "allocation, or disable headroom_admission"
+                    )
                 return
             # has_work but nothing schedulable: every sequence is stalled on
             # KV-pool capacity and nothing can ever free a block — a silent
@@ -3710,6 +3771,10 @@ class RaggedInferenceEngine:
         """Scheduler-state gauges after each step: KV-page occupancy, queue
         depth, cumulative dispatch/padding counters."""
         tel = self.telemetry
+        if self._memledger_handles is None and tel.memledger is not None:
+            # ledger configured after engine construction: register now
+            # (mirrors the training engine's lazy first-step registration)
+            self._register_memory_owners()
         usable = self.cfg.num_blocks - 1  # block 0 is scratch
         free = self.allocator.free_blocks
         g = tel.gauge

@@ -16,7 +16,11 @@ shapes exist:
   is derived state (prefix-cache retained blocks × block bytes, parked
   handoff blocks, the staging cache) — a zero-argument callable read at
   gauge-refresh time, held via weakref-style None-pruning so a dead engine
-  never leaks through the ledger.
+  never leaks through the ledger. A provider whose bytes are a *subset* of
+  another owner's allocation (retained/handoff blocks live inside the
+  ``kv_pool`` arrays) registers with ``carveout_of``: its bytes move out of
+  the parent's attribution instead of adding to the total, so
+  ``attributed_bytes`` counts each real byte exactly once.
 
 ``census()`` sums every live jax array in the process and reconciles it
 against the ledger: ``memory_unattributed_bytes = live − attributed`` is a
@@ -162,15 +166,27 @@ class MemoryLedger:
             except ValueError:
                 pass  # double release is harmless
 
-    def register_provider(self, owner: str, name: str, fn) -> None:
+    def register_provider(self, owner: str, name: str, fn,
+                          carveout_of: str | None = None) -> None:
         """Attribute a *derived* byte count: ``fn()`` is read at every gauge
         refresh / census / breakdown. A provider returning None is pruned
         (the weakref-holding idiom: closures over ``weakref.ref(engine)``
-        return None once the engine dies, so the ledger never pins it)."""
+        return None once the engine dies, so the ledger never pins it).
+
+        ``carveout_of`` marks the provider as a *subset* of another owner's
+        already-registered bytes (prefix-LRU retained blocks and parked
+        handoff blocks live inside the ``kv_pool`` arrays): the bytes show
+        under the provider's own owner in the breakdown but are subtracted
+        from the parent, so the attributed total counts each real byte
+        exactly once — double-counting would inflate ``attributed_bytes``
+        past the census and shrink the unattributed leak signal the census
+        exists to catch."""
         if owner not in OWNERS:
             raise ValueError(f"unknown memory owner {owner!r}")
+        if carveout_of is not None and carveout_of not in OWNERS:
+            raise ValueError(f"unknown carveout parent {carveout_of!r}")
         with self._lock:
-            self._providers.append([owner, name, fn])
+            self._providers.append([owner, name, fn, carveout_of])
 
     # ------------------------------------------------------------ programs
     def note_program(self, key, compiled) -> dict | None:
@@ -218,7 +234,9 @@ class MemoryLedger:
     # ----------------------------------------------------------- breakdown
     def owner_bytes(self) -> dict:
         """``{owner: attributed_bytes}`` over every live handle + provider
-        (all owners present, zero-filled, so dashboards never miss series)."""
+        (all owners present, zero-filled, so dashboards never miss series).
+        Carve-out providers move bytes out of their parent owner rather
+        than adding new ones, so the dict sums to each real byte once."""
         out = {o: 0 for o in OWNERS}
         with self._lock:
             handles = list(self._handles)
@@ -234,7 +252,16 @@ class MemoryLedger:
             if v is None:
                 dead.append(p)
                 continue
-            out[p[0]] += int(v)
+            v = int(v)
+            parent = p[3]
+            if parent is not None:
+                # a subset of the parent's bytes changes attribution, not
+                # the total; never drive the parent negative (an over-
+                # reporting carve-out would then shrink the sum and show
+                # up as census overattribution — its own smell)
+                v = min(v, max(0, out[parent]))
+                out[parent] -= v
+            out[p[0]] += v
         if dead:
             with self._lock:
                 self._providers = [p for p in self._providers if p not in dead]
@@ -251,7 +278,11 @@ class MemoryLedger:
                 {"owner": h.owner, "name": h.name, "nbytes": h.nbytes}
                 for h in self._handles
             ]
-            providers = [{"owner": o, "name": n} for o, n, _ in self._providers]
+            providers = [
+                {"owner": o, "name": n,
+                 **({"carveout_of": c} if c else {})}
+                for o, n, _, c in self._providers
+            ]
         return {
             "owners": owners,
             "attributed_bytes": sum(owners.values()),
@@ -261,9 +292,17 @@ class MemoryLedger:
         }
 
     # -------------------------------------------------------------- census
-    def census(self, step: int | None = None) -> dict:
+    def census(self, step: int | None = None, *,
+               update_state: bool = True) -> dict:
         """Reconcile ledger vs reality: sum every live jax array, compute
-        the unattributed gap, update gauges, and run the drift alarm."""
+        the unattributed gap, update gauges, and run the drift alarm.
+
+        ``update_state=False`` is the read-only variant for the
+        ``/debug/memory`` endpoint and OOM forensics: it reports the same
+        reconciliation but never touches the drift-alarm state machine —
+        the alarm's "N *consecutive* censuses" semantics belong to the
+        step-loop cadence, and a scrape at an arbitrary cadence mutating
+        ``_drift_streak`` would fire or suppress it spuriously."""
         import jax
 
         live_bytes = 0
@@ -282,14 +321,16 @@ class MemoryLedger:
         overattributed = max(0, attributed - live_bytes)
         frac = unattributed / live_bytes if live_bytes else 0.0
         alarm = False
-        if frac > self.drift_threshold:
-            self._drift_streak += 1
-            if self._drift_streak >= self.drift_consecutive:
-                alarm = True
-                self.drift_alarms += 1
-                self._drift_streak = 0
-        else:
-            self._drift_streak = 0
+        if update_state:
+            with self._lock:  # the endpoint thread races the step loop
+                if frac > self.drift_threshold:
+                    self._drift_streak += 1
+                    if self._drift_streak >= self.drift_consecutive:
+                        alarm = True
+                        self.drift_alarms += 1
+                        self._drift_streak = 0
+                else:
+                    self._drift_streak = 0
         out = {
             "live_bytes": live_bytes,
             "live_arrays": live_count,
@@ -300,7 +341,8 @@ class MemoryLedger:
             "drift_alarm": alarm,
             "drift_alarms_total": self.drift_alarms,
         }
-        self._last_census = out
+        if update_state:
+            self._last_census = out
         tel = self.telemetry
         if tel.enabled:
             g = tel.gauge
@@ -355,7 +397,9 @@ class MemoryLedger:
         """The ``GET /debug/memory`` response: breakdown + fresh census +
         device watermarks in one JSON-serializable dict."""
         payload = self.breakdown()
-        payload["census"] = self.census()
+        # read-only census: scraping the endpoint must not perturb the
+        # step-loop drift-alarm state machine
+        payload["census"] = self.census(update_state=False)
         payload["device"] = self._device_stats()
         payload["enabled"] = True
         return payload
@@ -389,7 +433,10 @@ class MemoryLedger:
                 "context": context or {},
                 **self.breakdown(),
             }
-            report["census"] = self.census()
+            # read-only: forensics must document the drift state, not
+            # advance it (an OOM mid-window would otherwise skew the
+            # consecutive-census alarm)
+            report["census"] = self.census(update_state=False)
             report["device"] = self._device_stats()
             os.makedirs(self.report_dir, exist_ok=True)
             path = os.path.join(

@@ -118,6 +118,54 @@ class TestLedgerAccounting:
         led.owner_bytes()
         assert len(calls) == 2  # pruned providers are not called again
 
+    def test_carveout_provider_moves_bytes_not_adds(self, tmp_path):
+        """prefix-LRU / handoff bytes live INSIDE the kv_pool arrays: a
+        carve-out re-attributes them without double-counting, so the
+        attributed total still equals the real pool bytes."""
+        led = _ledger(tmp_path)
+        led.register("kv_pool", "test/pool", 1000)
+        led.register_provider("prefix_cache_retained", "test/lru",
+                              lambda: 300, carveout_of="kv_pool")
+        led.register_provider("kv_handoff", "test/parked",
+                              lambda: 100, carveout_of="kv_pool")
+        owners = led.owner_bytes()
+        assert owners["kv_pool"] == 600
+        assert owners["prefix_cache_retained"] == 300
+        assert owners["kv_handoff"] == 100
+        assert led.attributed_bytes() == 1000  # each byte counted once
+        providers = led.breakdown()["providers"]
+        assert {"owner": "prefix_cache_retained", "name": "test/lru",
+                "carveout_of": "kv_pool"} in providers
+        with pytest.raises(ValueError):
+            led.register_provider("kv_handoff", "x", lambda: 0,
+                                  carveout_of="nonsense_owner")
+
+    def test_carveout_never_drives_parent_negative(self, tmp_path):
+        led = _ledger(tmp_path)
+        led.register("kv_pool", "test/pool", 100)
+        led.register_provider("prefix_cache_retained", "test/over",
+                              lambda: 500, carveout_of="kv_pool")
+        owners = led.owner_bytes()
+        assert owners["kv_pool"] == 0
+        assert owners["prefix_cache_retained"] == 100  # clamped to parent
+        assert led.attributed_bytes() == 100
+
+    def test_engine_pool_bytes_not_double_counted(self, tmp_path):
+        """End-to-end: retained prefix blocks re-attribute pool bytes, so
+        kv_pool + carve-outs must equal the real cache bytes exactly (the
+        pre-carve-out ledger summed to cache + retained, overstating)."""
+        led = _ledger(tmp_path)
+        eng = _engine(enable_prefix_cache=True)
+        _put_all(eng)
+        eng.generate_all()
+        assert eng.allocator.retained_blocks > 0  # retirement published
+        owners = led.owner_bytes()
+        assert owners["prefix_cache_retained"] \
+            == eng.allocator.retained_blocks * eng._block_bytes()
+        pool_total = (owners["kv_pool"] + owners["prefix_cache_retained"]
+                      + owners["kv_handoff"])
+        assert pool_total == tree_nbytes(eng.cache)
+
     def test_tree_nbytes(self):
         assert tree_nbytes(None) == 0
         assert tree_nbytes(12345) == 12345
@@ -168,11 +216,42 @@ class TestCensus:
         assert c["drift_alarm"] and c["drift_alarms_total"] == 1
         assert not led.census()["drift_alarm"]  # streak reset after firing
 
+    def test_readonly_census_leaves_drift_state_alone(self, tmp_path):
+        """GET /debug/memory and OOM forensics run read-only censuses: a
+        scrape at any cadence must not advance (or reset) the step-loop's
+        N-consecutive-census alarm streak."""
+        led = _ledger(tmp_path, drift_threshold=0.0, drift_consecutive=3)
+        leak = jnp.zeros(1024)
+        leak.block_until_ready()
+        assert not led.census()["drift_alarm"]
+        assert not led.census()["drift_alarm"]  # streak = 2
+        for _ in range(5):
+            ro = led.census(update_state=False)
+            assert not ro["drift_alarm"]
+        led.debug_payload()  # endpoint scrape: also read-only
+        # third state-updating census still completes the streak exactly
+        c = led.census()
+        assert c["drift_alarm"] and c["drift_alarms_total"] == 1
+
     def test_census_interval(self, tmp_path):
         led = _ledger(tmp_path, census_interval_steps=3)
         assert led.maybe_census(1) is None
         assert led.maybe_census(2) is None
         assert led.maybe_census(3) is not None
+
+    def test_lazy_registration_after_configure(self, tmp_path):
+        """Ledger configured AFTER engine construction (the common serving
+        bring-up order): the per-step hook registers the owners on the
+        first telemetry-enabled step instead of never."""
+        telemetry.configure(enabled=False)
+        eng = _engine()
+        assert eng._memledger_handles is None  # nothing to register yet
+        led = _ledger(tmp_path)
+        _put_all(eng)
+        eng.generate_all()
+        assert eng._memledger_handles is not None
+        owners = led.owner_bytes()
+        assert owners["kv_pool"] > 0 and owners["params"] > 0
 
     def test_reset_state_refreshes_handles(self, tmp_path):
         led = _ledger(tmp_path)
@@ -245,13 +324,14 @@ class TestOomForensics:
 # ------------------------------------------------------ headroom admission
 class TestHeadroomAdmission:
     def test_unknown_backend_is_static_parity(self, ref_tokens):
-        eng = _engine()  # CPU accelerator: bytes_limit=0 -> headroom -1
+        # CPU accelerator: bytes_limit=0 -> headroom -1 even when enabled
+        eng = _engine(headroom_admission=True)
         assert eng.admission_headroom_blocks() == -1
         _put_all(eng)
         assert eng.generate_all() == ref_tokens
 
     def test_ample_headroom_is_parity(self, ref_tokens):
-        eng = _engine()
+        eng = _engine(headroom_admission=True)
         bb = eng._block_bytes()
         eng._mem_stats_fn = lambda: {
             "bytes_limit": 10_000 * bb, "bytes_in_use": 0}
@@ -259,16 +339,26 @@ class TestHeadroomAdmission:
         _put_all(eng)
         assert eng.generate_all() == ref_tokens
 
-    def test_scarce_headroom_pins_admission(self):
-        eng = _engine()
+    def test_headroom_nets_out_preallocated_pool(self):
+        """The pool's free blocks are device bytes already funded at init:
+        a device that merely LOOKS full because the pool preallocated it
+        must not pin admission (the silent-hang regression)."""
+        eng = _engine(headroom_admission=True)
         bb = eng._block_bytes()
-        # headroom math: (limit - in_use - guard) // block_bytes
+        free_pool = eng.allocator.free_blocks  # 48: num_blocks-1 usable
+        # device "full" but the deficit is exactly the pool's own footprint:
+        # headroom = free_dev(10) + pool(48) - guard(5% of 1000 = 50) = 8
         eng._mem_stats_fn = lambda: {
-            "bytes_limit": 100 * bb,
-            "bytes_in_use": 90 * bb}  # guard 5% -> 5 blocks
-        assert eng.admission_headroom_blocks() == 5
+            "bytes_limit": 1000 * bb, "bytes_in_use": 990 * bb}
+        assert eng.admission_headroom_blocks() == 10 + free_pool - 50
+
+    def test_scarce_headroom_pins_admission(self):
+        eng = _engine(headroom_admission=True)
+        bb = eng._block_bytes()
+        # external pressure beyond what the pool could fund: free_dev=0,
+        # pool credit 48 blocks, guard 5% of 2000 = 100 blocks -> 0
         eng._mem_stats_fn = lambda: {
-            "bytes_limit": 100 * bb, "bytes_in_use": 100 * bb}
+            "bytes_limit": 2000 * bb, "bytes_in_use": 2000 * bb}
         assert eng.admission_headroom_blocks() == 0
         _put_all(eng)
         eng.step()
@@ -279,22 +369,37 @@ class TestHeadroomAdmission:
         eng.step()
         assert eng._running
 
-    def test_disabled_knob_is_unknown(self):
-        eng = _engine(headroom_admission=False)
+    def test_headroom_stall_alarm_raises(self):
+        """A headroom wait that never lifts must become a loud failure,
+        not a silent forever-idle loop (the guard suppression bug)."""
+        eng = _engine(headroom_admission=True, headroom_stall_alarm_ticks=3)
+        bb = eng._block_bytes()
+        eng._mem_stats_fn = lambda: {
+            "bytes_limit": 5000 * bb, "bytes_in_use": 5000 * bb}
+        _put_all(eng)
+        eng.step()
+        eng.step()
+        with pytest.raises(RuntimeError, match="headroom admission stalled"):
+            eng.step()
+
+    def test_default_is_off_and_disabled_knob_is_unknown(self):
+        eng = _engine()
+        assert eng.cfg.headroom_admission is False  # opt-in by default
         eng._mem_stats_fn = lambda: {"bytes_limit": 1 << 40, "bytes_in_use": 0}
         assert eng.admission_headroom_blocks() == -1
 
     def test_replica_stats_surface_headroom(self):
         from deepspeed_tpu.serving.engine_loop import EngineLoop
 
-        eng = _engine()
+        eng = _engine(headroom_admission=True)
         bb = eng._block_bytes()
+        free_pool = eng.allocator.free_blocks
         eng._mem_stats_fn = lambda: {
             "bytes_limit": 1000 * bb, "bytes_in_use": 0}
         loop = EngineLoop(eng, name="r0")
         try:
             s = loop.stats()
-            assert s.headroom_blocks == 950
+            assert s.headroom_blocks == 1000 + free_pool - 50
         finally:
             loop.close()
 
