@@ -1616,6 +1616,18 @@ def train_chaos_worker_main():
         "checkpoint": {"keep_n_latest": 3},
         "seed": 5,
     }
+    if e.get("CHAOS_SENTINEL"):
+        # self-healing legs: the divergence sentinel with quarantine state
+        # persisted under the work dir (a pre-seeded quarantine.json is how
+        # the clean-reference run skips the batches the chaos run healed
+        # around) and the heartbeat beacon the elastic agent polls
+        config["sentinel"] = {
+            "enabled": True,
+            "warmup_steps": 3,
+            "report_dir": os.path.join(work_dir, "reports"),
+            "state_dir": os.path.join(work_dir, "state"),
+            "checkpoint_dir": ckpt_dir,
+        }
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=lambda ctx: llama.build(model_cfg, ctx=ctx), config=config,
         training_data=loader, seed=5)
@@ -1644,14 +1656,26 @@ def train_chaos_worker_main():
     while engine.global_steps < total_steps:
         step = engine.global_steps
         loss = engine.train_batch()
+        if engine.global_steps <= step:
+            # the sentinel rolled back: the step counter rewound to the
+            # pinned checkpoint. Don't log the anomalous loss — the replay
+            # re-logs every step from the restore point (last write wins in
+            # the orchestrator's stitched-parity check).
+            append_event(status_path, {"event": "rollback", "from": step,
+                                       "to": engine.global_steps})
+            continue
         append_event(traj_path, {"step": step,
                                  "loss": float(np.asarray(loss))})
         if engine.global_steps % save_every == 0:
             tag = f"global_step{engine.global_steps}"
             engine.save_checkpoint(ckpt_dir)
             append_event(status_path, {"event": "saved", "tag": tag})
+    done = {"event": "done", "step": engine.global_steps}
+    if engine._sentinel is not None:
+        done["rollbacks"] = engine.train_rollbacks
+        done["quarantined"] = engine._sentinel.quarantined
     engine.destroy()
-    append_event(status_path, {"event": "done", "step": engine.global_steps})
+    append_event(status_path, done)
     print("CHAOS_WORKER_DONE")
     return 0
 
@@ -1698,17 +1722,21 @@ def _train_chaos_impl():
     bench_path = os.path.abspath(__file__)
     root = tempfile.mkdtemp(prefix="train_chaos_")
 
-    def worker_env(work_dir, faults=None):
+    def worker_env(work_dir, faults=None, sentinel=False, total=None,
+                   save_every=None):
         env = dict(os.environ)
         env.pop("BENCH_TRAIN_CHAOS", None)
         env.update(
             BENCH_TRAIN_CHAOS_WORKER="1",
             CHAOS_DIR=work_dir,
-            CHAOS_TOTAL_STEPS=str(total_steps),
-            CHAOS_SAVE_EVERY=str(int(e.get("CHAOS_SAVE_EVERY", 2))),
+            CHAOS_TOTAL_STEPS=str(total if total is not None else total_steps),
+            CHAOS_SAVE_EVERY=str(save_every if save_every is not None
+                                 else int(e.get("CHAOS_SAVE_EVERY", 2))),
             CHAOS_SEED=str(seed),
             CHAOS_FAULTS=json.dumps(faults or []),
         )
+        if sentinel:
+            env["CHAOS_SENTINEL"] = "1"
         return env
 
     def read_jsonl(path):
@@ -1723,12 +1751,14 @@ def _train_chaos_impl():
                     pass  # torn trailing line from a kill mid-append
         return out
 
-    def run_worker(work_dir, faults=None, kill_after=None, log_name="w"):
+    def run_worker(work_dir, faults=None, kill_after=None, log_name="w",
+                   **env_kw):
         """One worker run. Returns the exit code (negative = signal)."""
         os.makedirs(work_dir, exist_ok=True)
         log = open(os.path.join(work_dir, f"{log_name}.log"), "ab")
         proc = subprocess.Popen(
-            [sys.executable, bench_path], env=worker_env(work_dir, faults),
+            [sys.executable, bench_path],
+            env=worker_env(work_dir, faults, **env_kw),
             stdout=log, stderr=log, cwd=os.path.dirname(bench_path))
         try:
             if kill_after is not None:
@@ -1857,6 +1887,109 @@ def _train_chaos_impl():
                   and elastic_parity)
     world_reduced = getattr(agent, "world_size", 2) == 1
 
+    # ---- phase 4: divergence leg — self-healing from poisoned math.
+    # One run eats a nan-grads fault (strike 1: quarantine + pin the
+    # pre-anomaly tag) and a content-keyed poison-batch fault (strike 2:
+    # rollback to the pin and replay with the quarantine applied). Then a
+    # clean reference run — pre-armed with the chaos run's final quarantine
+    # so its data stream is aligned — must produce a step-identical loss
+    # trajectory: the healed run is indistinguishable from one that never
+    # saw the poison.
+    import numpy as np
+
+    from deepspeed_tpu.runtime import sentinel as sentinel_mod
+
+    sent_total, sent_save = 16, 3
+
+    def chaos_batch_for(i):  # mirrors the worker's deterministic stream
+        brng = np.random.default_rng(777 + i)
+        return {"input_ids": brng.integers(0, 97, (4, 32), dtype=np.int32)}
+
+    poison_fp = sentinel_mod.batch_fingerprint(chaos_batch_for(10))
+    sent_chaos = os.path.join(root, "sent_chaos")
+    sent_rc = run_worker(
+        sent_chaos,
+        faults=[
+            {"point": "train.grads", "kind": "nan-grads", "after": 6,
+             "times": 1},
+            {"point": "data.batch", "kind": "poison-batch",
+             "request_id": poison_fp, "times": 1},
+        ],
+        log_name="sent_chaos", sentinel=True, total=sent_total,
+        save_every=sent_save)
+    sent_status = read_jsonl(os.path.join(sent_chaos, "status.jsonl"))
+    sent_rollbacks = [s for s in sent_status if s["event"] == "rollback"]
+    sent_done = [s for s in sent_status if s["event"] == "done"]
+    sent_quarantine = sentinel_mod.load_quarantine(
+        os.path.join(sent_chaos, "state"))
+    report_dir = os.path.join(sent_chaos, "reports")
+    sent_reports = []
+    if os.path.isdir(report_dir):
+        for name in sorted(os.listdir(report_dir)):
+            with open(os.path.join(report_dir, name)) as f:
+                sent_reports.append((name, json.load(f)))
+
+    # clean reference: same workload, no faults, quarantine pre-seeded so
+    # the stream skips exactly the batches the chaos run learned to avoid
+    sent_ref = os.path.join(root, "sent_ref")
+    os.makedirs(os.path.join(sent_ref, "state"), exist_ok=True)
+    sentinel_mod.save_quarantine(os.path.join(sent_ref, "state"),
+                                 sent_quarantine)
+    sent_ref_rc = run_worker(sent_ref, log_name="sent_ref", sentinel=True,
+                             total=sent_total, save_every=sent_save)
+    ref_last = {r["step"]: r["loss"] for r in read_jsonl(
+        os.path.join(sent_ref, "trajectory.jsonl"))}
+    chaos_last = {r["step"]: r["loss"] for r in read_jsonl(
+        os.path.join(sent_chaos, "trajectory.jsonl"))}
+    sent_max_rel = 0.0
+    for s in range(sent_total):
+        a, b = chaos_last.get(s), ref_last.get(s)
+        if a is None or b is None or a != a or b != b:
+            sent_max_rel = float("inf")
+            continue
+        sent_max_rel = max(sent_max_rel, abs(a - b) / max(1e-12, abs(b)))
+    sent_parity = (set(chaos_last) == set(range(sent_total))
+                   and sent_max_rel <= 1e-5)
+    sent_forensics_ok = (
+        bool(sent_reports)
+        and any(n.startswith("sentinel_rollback") for n, _ in sent_reports)
+        and all(r for _, r in sent_reports))
+
+    # ---- phase 5: liveness leg — a wedge fault blocks the device fence
+    # forever; the worker's heartbeat beacon goes stale, the agent SIGKILLs
+    # the wedged-but-alive process, and the relaunch (no fault armed) heals
+    # from the last checkpoint
+    hb_dir = os.path.join(root, "wedge")
+    os.makedirs(hb_dir, exist_ok=True)
+    wedge_total, wedge_save = 8, 2
+    wedge_armed = {"first": True}
+
+    def make_wedge_worker(rank, world):
+        faults = []
+        if wedge_armed["first"]:
+            # arm only the first incarnation: the relaunch must run clean
+            wedge_armed["first"] = False
+            faults = [{"point": "train.dispatch", "kind": "wedge",
+                       "delay_s": 600.0, "after": 4, "times": 1}]
+        return WorkerSpec(cmd=[sys.executable, bench_path],
+                          env=worker_env(hb_dir, faults, sentinel=True,
+                                         total=wedge_total,
+                                         save_every=wedge_save))
+
+    wedge_agent = ElasticAgent(
+        target_batch_size=4, micro_batch_candidates=[2, 4],
+        make_worker=make_wedge_worker, max_world_size=1, min_world_size=1,
+        poll_interval=0.5, max_restarts=3,
+        heartbeat_dir=os.path.join(hb_dir, "state"),
+        heartbeat_timeout=5.0, heartbeat_grace=60.0)
+    wedge_rc = wedge_agent.run()
+    wedge_status = read_jsonl(os.path.join(hb_dir, "status.jsonl"))
+    wedge_done = [s for s in wedge_status if s["event"] == "done"]
+    wedge_kills = getattr(wedge_agent, "heartbeat_kills", 0)
+    wedge_ok = (wedge_rc == 0 and bool(wedge_done)
+                and wedge_kills >= 1
+                and getattr(wedge_agent, "restarts", 0) >= 1)
+
     checks = {
         "completed": completed,
         "always_loadable": always_loadable,
@@ -1867,6 +2000,12 @@ def _train_chaos_impl():
         "fallback_observed": bool(fallbacks),
         "elastic_ok": elastic_ok,
         "elastic_world_reduced": world_reduced,
+        "sentinel_self_heals": sent_rc == 0 and bool(sent_done),
+        "sentinel_quarantined_two": len(sent_quarantine) == 2,
+        "sentinel_one_rollback": len(sent_rollbacks) == 1,
+        "sentinel_stitched_parity": sent_ref_rc == 0 and sent_parity,
+        "sentinel_forensics": sent_forensics_ok,
+        "wedge_heartbeat_kill": wedge_ok,
     }
     ok = all(checks.values())
     if ok:
@@ -1889,12 +2028,19 @@ def _train_chaos_impl():
         "elastic_agent_rc": agent_rc,
         "elastic_agent_restarts": getattr(agent, "restarts", None),
         "elastic_agent_world": getattr(agent, "world_size", None),
+        "sentinel_rollbacks": len(sent_rollbacks),
+        "sentinel_quarantined": sent_quarantine,
+        "sentinel_reports": [n for n, _ in sent_reports],
+        "sentinel_max_rel_loss_diff": sent_max_rel,
+        "wedge_heartbeat_kills": wedge_kills,
+        "wedge_agent_rc": wedge_rc,
+        "wedge_agent_restarts": getattr(wedge_agent, "restarts", None),
         "backend": jax.default_backend(),
     }))
     return 0 if ok else 1
 
 
-def run_train_chaos_subprocess(timeout: float = 900.0):
+def run_train_chaos_subprocess(timeout: float = 1050.0):
     return _run_flagged_subprocess("BENCH_TRAIN_CHAOS", timeout)
 
 

@@ -619,6 +619,65 @@ class DebugConfig(ConfigBase):
 
 
 @dataclass
+class SentinelConfig(ConfigBase):
+    """Self-healing training (``runtime/sentinel.py``, see
+    docs/FAULT_TOLERANCE.md "Training: self-healing"): a divergence verdict
+    fused into the jitted train step (loss vs. rolling EMA + k·σ, grad-norm
+    vs. rolling quantile, consecutive-skip streak), a quarantine →
+    rollback-and-replay → reduce-lr/halt policy ladder, a dispatch watchdog,
+    and a per-worker heartbeat file the elastic agent polls. Off by default:
+    the disabled engine traces the exact same step program as before."""
+
+    enabled: bool = False
+    # ---- verdict thresholds (device-side, computed in the fused step)
+    warmup_steps: int = 20          # accepted steps before the loss gate arms
+    loss_ema_beta: float = 0.9      # EMA decay for loss mean/variance
+    loss_sigma_k: float = 4.0       # anomalous when loss > ema + k*sigma
+    loss_rel_floor: float = 0.05    # sigma floor as a fraction of |ema|
+    grad_window: int = 32           # rolling grad-norm ring length
+    grad_quantile: float = 0.95     # ring quantile the gate compares against
+    grad_quantile_mult: float = 8.0 # anomalous when gnorm > mult * quantile
+    # streak escalation threshold; matches precision.update_loss_scale
+    # semantics exactly (streak resets to 0 on any accepted step, the way
+    # good_steps resets on a single overflow)
+    max_consecutive_skips: int = 5
+    # ---- policy ladder (host-side, acts on settled verdicts)
+    window_steps: int = 50          # strikes within this window escalate
+    rollback: bool = True           # rung 2: restore + replay (else skip rung)
+    checkpoint_dir: Optional[str] = None  # ladder restores from this save_dir
+    on_third_strike: str = "halt"   # halt | reduce-lr
+    lr_backoff: float = 0.5         # reduce-lr multiplier per backoff
+    max_wedges: int = 3             # wedge timeouts in the window before halt
+    report_dir: str = "sentinel_reports"  # forensics JSON directory
+    state_dir: Optional[str] = None # quarantine persistence + heartbeat files
+    # ---- liveness
+    dispatch_timeout_s: float = 0.0 # >0: per-step settle under this deadline
+    heartbeat_interval_s: float = 1.0  # min seconds between heartbeat writes
+
+    def _validate(self, path: str = "") -> None:
+        if self.on_third_strike not in ("halt", "reduce-lr"):
+            raise ConfigError(
+                f"{path}on_third_strike: must be halt|reduce-lr, got "
+                f"{self.on_third_strike!r}")
+        if not (0.0 < self.loss_ema_beta < 1.0):
+            raise ConfigError(
+                f"{path}loss_ema_beta: must be in (0, 1), got "
+                f"{self.loss_ema_beta}")
+        if self.grad_window < 4:
+            raise ConfigError(
+                f"{path}grad_window: must be >= 4, got {self.grad_window}")
+        if not (0.0 < self.grad_quantile < 1.0):
+            raise ConfigError(
+                f"{path}grad_quantile: must be in (0, 1), got "
+                f"{self.grad_quantile}")
+        if not (0.0 < self.lr_backoff < 1.0):
+            raise ConfigError(
+                f"{path}lr_backoff: must be in (0, 1), got {self.lr_backoff}")
+        if self.window_steps < 1:
+            raise ConfigError(f"{path}window_steps: must be >= 1")
+
+
+@dataclass
 class Config(ConfigBase):
     """Top-level framework config (reference: ``DeepSpeedConfig``)."""
 
@@ -656,6 +715,7 @@ class Config(ConfigBase):
     progressive_layer_drop: ProgressiveLayerDropConfig = field(
         default_factory=ProgressiveLayerDropConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
+    sentinel: SentinelConfig = field(default_factory=SentinelConfig)
     # reference ds_config["compression_training"] shape, parsed by
     # deepspeed_tpu.compression.CompressionConfig (QAT + pruning schedules)
     compression_training: dict = field(default_factory=dict)

@@ -20,6 +20,8 @@ signal path (SIGTERM → stop admission → finish inflight → exit).
 
 from __future__ import annotations
 
+import json
+import os
 import signal
 import subprocess
 import sys
@@ -61,6 +63,14 @@ class ElasticAgent:
     max_restarts: int = 3
     on_scale_change: Callable[[int], None] | None = None
     workers: list = field(default_factory=list)
+    # liveness (runtime/sentinel.py heartbeat protocol): workers write
+    # heartbeat_{rank}.json into heartbeat_dir at step boundaries; a live
+    # process whose beacon goes stale past heartbeat_timeout is wedged —
+    # SIGKILL it and restart the world. 0 disables the check. The grace
+    # window covers startup (jit compile happens before the first beat).
+    heartbeat_dir: str | None = None
+    heartbeat_timeout: float = 0.0
+    heartbeat_grace: float = 30.0
 
     def admissible_world_sizes(self) -> list[int]:
         sizes = get_compatible_world_sizes(
@@ -75,8 +85,41 @@ class ElasticAgent:
             )
         return sizes
 
+    def _sweep_stale_state(self) -> None:
+        """Remove sentinel state a killed worker left behind. Heartbeat
+        beacons are per-incarnation liveness — a stale one from a SIGKILL'd
+        predecessor would either mask a wedge or trigger an instant false
+        kill, so they are always removed. The quarantine list is healing
+        MEMORY and is kept — unless it is torn/unparseable (a worker died
+        mid-write before the atomic-rename writer existed), in which case a
+        fresh start beats honoring garbage."""
+        d = self.heartbeat_dir
+        if not d or not os.path.isdir(d):
+            return
+        for name in os.listdir(d):
+            path = os.path.join(d, name)
+            if name.startswith("heartbeat_"):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            elif name == "quarantine.json":
+                try:
+                    with open(path) as f:
+                        if not isinstance(json.load(f), list):
+                            raise ValueError("not a list")
+                except (OSError, ValueError):
+                    try:
+                        os.remove(path)
+                        log_dist("elastic agent: removed torn quarantine "
+                                 "file", ranks=[0])
+                    except OSError:
+                        pass
+
     def _launch(self, world: int) -> None:
+        self._sweep_stale_state()
         self.workers = []
+        self._launch_time = time.monotonic()
         for rank in range(world):
             spec = self.make_worker(rank, world)
             spec.proc = subprocess.Popen(
@@ -86,6 +129,32 @@ class ElasticAgent:
             )
             self.workers.append(spec)
         log_dist(f"elastic agent: launched {world} workers", ranks=[0])
+
+    def _stale_workers(self) -> list[int]:
+        """Ranks whose process is alive but whose heartbeat beacon is older
+        than the deadline (wedged-but-alive: a hung collective, a stuck
+        device program — the one failure mode ``proc.poll()`` cannot see)."""
+        if not self.heartbeat_dir or self.heartbeat_timeout <= 0:
+            return []
+        now = time.monotonic()
+        wall = time.time()
+        stale = []
+        for rank, w in enumerate(self.workers):
+            if w.proc.poll() is not None:
+                continue
+            path = os.path.join(self.heartbeat_dir, f"heartbeat_{rank}.json")
+            try:
+                age = wall - os.path.getmtime(path)
+            except OSError:
+                # no beacon yet: only the grace window applies
+                age = None
+            in_grace = now - self._launch_time < max(
+                self.heartbeat_grace, self.heartbeat_timeout)
+            if in_grace:
+                continue
+            if age is None or age > self.heartbeat_timeout:
+                stale.append(rank)
+        return stale
 
     def run(self) -> int:
         """Supervision loop (reference ``_invoke_run:127``): launch at the
@@ -100,9 +169,24 @@ class ElasticAgent:
         world = self.admissible_world_sizes()[-1]
         self.restarts = 0
         self.world_size = world
+        self.heartbeat_kills = 0
         self._launch(world)
         while True:
             time.sleep(self.poll_interval)
+            for rank in self._stale_workers():
+                # wedged-but-alive: poll() sees nothing wrong, the beacon
+                # does. SIGKILL (a stuck device program ignores SIGTERM)
+                # and let the death branch below run the normal restart.
+                w = self.workers[rank]
+                log_dist(
+                    f"elastic agent: worker {rank} heartbeat stale "
+                    f"(> {self.heartbeat_timeout:.0f}s); killing", ranks=[0])
+                self.heartbeat_kills += 1
+                try:
+                    w.proc.kill()
+                    w.proc.wait(timeout=30)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
             codes = [w.proc.poll() for w in self.workers]
             if all(c == 0 for c in codes):
                 log_dist("elastic agent: all workers finished", ranks=[0])

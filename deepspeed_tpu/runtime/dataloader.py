@@ -14,7 +14,38 @@ from typing import Iterator
 import numpy as np
 
 
-class RepeatingLoader:
+class _QuarantineMixin:
+    """Fingerprint-keyed batch quarantine shared by the checkpointable
+    loaders (runtime/sentinel.py self-healing ladder): a quarantined batch
+    is pulled from the underlying stream and dropped, so position state —
+    which counts RAW pulls — stays aligned with the stream while the
+    training loop never sees the batch again. The quarantine is monotonic
+    healing memory: ``load_state_dict`` unions, never clears."""
+
+    _quarantine: set
+    quarantined_skipped: int
+
+    def quarantine(self, fingerprints) -> None:
+        """Never deliver batches with these content fingerprints again
+        (``sentinel.batch_fingerprint`` of the microbatch dict)."""
+        self._quarantine.update(f for f in fingerprints if f)
+
+    @property
+    def quarantined(self) -> list:
+        return sorted(self._quarantine)
+
+    def _dequarantine(self, item, raw_next):
+        if not self._quarantine:
+            return item
+        from deepspeed_tpu.runtime.sentinel import batch_fingerprint
+
+        while batch_fingerprint(item) in self._quarantine:
+            self.quarantined_skipped += 1
+            item = raw_next()
+        return item
+
+
+class RepeatingLoader(_QuarantineMixin):
     """Wrap an iterable so it restarts on StopIteration (reference ``RepeatingLoader:17``).
 
     Carries checkpointable position state: ``state_dict()`` records
@@ -28,11 +59,13 @@ class RepeatingLoader:
         self._iter = iter(loader)
         self._epoch = 0
         self._pos = 0
+        self._quarantine = set()
+        self.quarantined_skipped = 0
 
     def __iter__(self):
         return self
 
-    def __next__(self):
+    def _raw_next(self):
         try:
             item = next(self._iter)
         except StopIteration:
@@ -43,8 +76,12 @@ class RepeatingLoader:
         self._pos += 1
         return item
 
+    def __next__(self):
+        return self._dequarantine(self._raw_next(), self._raw_next)
+
     def state_dict(self) -> dict:
-        return {"epoch": self._epoch, "pos": self._pos}
+        return {"epoch": self._epoch, "pos": self._pos,
+                "quarantine": self.quarantined}
 
     def load_state_dict(self, state: dict) -> None:
         self._epoch = 0
@@ -57,15 +94,18 @@ class RepeatingLoader:
             except StopIteration:
                 self._iter = iter(self.loader)
                 self._epoch += 1
+        # replay RAW pulls: position state counts the underlying stream, so
+        # quarantine skips (which happen on delivery) must not distort it
         for _ in range(int(state.get("pos", 0))):
-            next(self)
+            self._raw_next()
         # the skip above may have crossed an epoch boundary bookkeeping-wise;
         # pin the recorded position to the target
         self._epoch = target
         self._pos = int(state.get("pos", 0))
+        self.quarantine(state.get("quarantine", ()))
 
 
-class CheckpointableLoader:
+class CheckpointableLoader(_QuarantineMixin):
     """Make any iterator factory exactly resumable by counting batches.
 
     ``factory(skip)`` must return an iterator positioned after ``skip``
@@ -79,25 +119,32 @@ class CheckpointableLoader:
         self._factory = factory
         self._consumed = int(batches_consumed)
         self._iter = factory(self._consumed)
+        self._quarantine = set()
+        self.quarantined_skipped = 0
 
     def __iter__(self):
         return self
 
-    def __next__(self):
+    def _raw_next(self):
         item = next(self._iter)
         self._consumed += 1
         return item
+
+    def __next__(self):
+        return self._dequarantine(self._raw_next(), self._raw_next)
 
     @property
     def batches_consumed(self) -> int:
         return self._consumed
 
     def state_dict(self) -> dict:
-        return {"batches_consumed": self._consumed}
+        return {"batches_consumed": self._consumed,
+                "quarantine": self.quarantined}
 
     def load_state_dict(self, state: dict) -> None:
         self._consumed = int(state.get("batches_consumed", 0))
         self._iter = self._factory(self._consumed)
+        self.quarantine(state.get("quarantine", ()))
 
 
 def array_loader(

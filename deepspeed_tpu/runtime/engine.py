@@ -44,6 +44,7 @@ from deepspeed_tpu.parallel.partition import (
     plan_sharding,
 )
 from deepspeed_tpu.runtime import precision
+from deepspeed_tpu.runtime import sentinel as sentinel_mod
 from deepspeed_tpu.runtime.lr_schedules import LRScheduler, build_schedule
 from deepspeed_tpu.runtime.precision import LossScaleState
 from deepspeed_tpu.utils.logging import log_dist
@@ -676,6 +677,86 @@ class Engine:
                 "program); use offload_optimizer.device=cpu or schedule=gpipe"
             )
 
+        # self-healing training (runtime/sentinel.py, docs/FAULT_TOLERANCE.md
+        # "Training: self-healing"): the device-side anomaly verdict is fused
+        # into the step program, the host-side ladder quarantines / rolls
+        # back / halts on the settled verdict, and a heartbeat beacon gives
+        # the elastic agent wedge visibility. Off by default: the disabled
+        # engine traces the exact pre-sentinel step program.
+        sent_cfg = config.sentinel
+        self._sentinel: sentinel_mod.SentinelPolicy | None = None
+        self._sent_state = None
+        self._heartbeat = None
+        self._lr_scale = 1.0  # sentinel LR backoff; read at trace time
+        self._watchdog_timeout = 0.0
+        self._last_batch_fps: list[str] = []
+        self._last_save_dir: str | None = None
+        self.train_rollbacks = 0
+        from deepspeed_tpu.serving import faults as _faults_mod
+
+        self._faults = _faults_mod
+        self._fault_injector = _faults_mod.get_fault_injector()
+        if sent_cfg.enabled:
+            conflicts = {
+                "quantized_gradients": self._qgrad,
+                "zenflow": self._zenflow,
+                "offloaded optimizer state": self._offload_mode is not None,
+                "pipeline 1f1b": (config.pipeline.schedule == "1f1b"
+                                  and topo.size("pipeline") > 1),
+            }
+            bad = [k for k, v in conflicts.items() if v]
+            if bad:
+                raise ValueError(
+                    f"sentinel does not compose with {', '.join(bad)} "
+                    "(the anomaly verdict is fused into the plain GAS step "
+                    "program those paths replace)")
+            self._sentinel = sentinel_mod.SentinelPolicy(sent_cfg)
+            self._sent_state = sentinel_mod.init_state(sent_cfg)
+            self._watchdog_timeout = float(sent_cfg.dispatch_timeout_s)
+            # The persistent XLA compilation cache is OFF for sentinel runs:
+            # the sentinel step program deserialized from the cache into a
+            # process that load_checkpoint()s before its first dispatch
+            # miscompiles the donated-buffer aliasing (params silently go
+            # NaN, then glibc heap corruption) — observed on the CPU
+            # backend, and rollback-and-replay does exactly that restore
+            # sequence on every self-heal. Paying the recompile is the
+            # robustness trade; sentinel is off by default so other runs
+            # keep the cache.
+            try:
+                jax.config.update("jax_enable_compilation_cache", False)
+                jax.config.update("jax_compilation_cache_dir", None)
+                # the cache singleton may already be initialized (mesh
+                # building compiles before the engine exists) — reset it so
+                # the disable takes effect for this process
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc)
+
+                _cc.reset_cache()
+                log_dist("sentinel: persistent compilation cache disabled "
+                         "(deserialized donated-aliasing programs corrupt "
+                         "restored state)", ranks=[0])
+            except Exception:  # noqa: BLE001 - older jax without the knob
+                pass
+            if sent_cfg.state_dir:
+                import os as _os
+
+                rank = int(_os.environ.get("RANK", jax.process_index()))
+                self._heartbeat = sentinel_mod.Heartbeat(
+                    sent_cfg.state_dir, rank=rank,
+                    interval_s=sent_cfg.heartbeat_interval_s)
+            self._apply_quarantine_to_loader()
+            log_dist(
+                "sentinel: loss EMA+"
+                f"{sent_cfg.loss_sigma_k:g}sigma / grad q{sent_cfg.grad_quantile:g}"
+                f"x{sent_cfg.grad_quantile_mult:g} gates, window "
+                f"{sent_cfg.window_steps} steps, third strike -> "
+                f"{sent_cfg.on_third_strike}"
+                + (f", dispatch watchdog {self._watchdog_timeout:g}s"
+                   if self._watchdog_timeout else "")
+                + (f", {len(self._sentinel.quarantined)} quarantined "
+                   "fingerprint(s) restored"
+                   if self._sentinel.quarantined else ""), ranks=[0])
+
         self._train_batch_jit = None
         self._accum_jit = None
         self._apply_jit = None
@@ -762,6 +843,14 @@ class Engine:
     def _microbatch_grads(self, params, mb, rng, scale, step=None):
         """Scaled-loss grads for one microbatch, fp32, ZeRO-sharded."""
         cparams = self._cast_params(params)
+        # fault-injection rail (serving/faults.py train.grads / data.batch
+        # directive kinds): a NaN multiplier models nan-grads, a large
+        # finite one a poisoned/divergent batch — applied INSIDE the tape
+        # so the gradients blow up with the loss. Key presence is static
+        # per traced program; un-injected steps trace without it.
+        loss_mult = mb.get("__loss_mult__")
+        if loss_mult is not None:
+            mb = {k: v for k, v in mb.items() if k != "__loss_mult__"}
 
         def scaled_loss(cp):
             if self._compression is not None and step is not None:
@@ -778,14 +867,26 @@ class Engine:
                                                ltd_keep=self._ltd_active)
             else:
                 loss = self.model_spec.loss_fn(cp, mb, rng)
+            if loss_mult is not None:
+                loss = loss * loss_mult.reshape(-1)[0]
             return loss * scale
 
         loss_scaled, grads = jax.value_and_grad(scaled_loss)(cparams)
         return loss_scaled / scale, self._constrain_grads(grads)
 
-    def _update(self, params, opt_state, scale_state, grad_sum, n_micro, step):
+    def _update(self, params, opt_state, scale_state, grad_sum, n_micro, step,
+                loss=None, sent_state=None):
         """Shared optimizer-step tail (reference ``_take_model_step:3168``):
         unscale, overflow check, clip, update, loss-scale bookkeeping.
+
+        With ``sent_state`` (divergence sentinel enabled) the anomaly
+        verdict is computed HERE, in the same fused program that already
+        computes ``finite`` — a finite-but-divergent step (loss spike,
+        grad-norm explosion) gates the ``_tree_select`` exactly like an
+        overflow, at zero extra D2H syncs — and the call returns a 5-tuple
+        with the advanced :class:`sentinel.SentinelState`. Loss-scale
+        bookkeeping stays keyed on the raw ``finite`` (fp16 semantics are
+        the scaler's, not the sentinel's).
 
         With the host offload tier, the update walks the optimizer sub-groups
         sequentially inside the same XLA program — each group's state streams
@@ -801,19 +902,30 @@ class Engine:
             coef = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
             grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
         lr = self.lr_schedule(step)
+        if self._lr_scale != 1.0:
+            # sentinel third-strike backoff: a host constant folded in at
+            # trace time (changing it invalidates the step program)
+            lr = lr * jnp.float32(self._lr_scale)
+
+        gate = finite
+        new_sent = anomaly = reason = streak = None
+        if sent_state is not None:
+            new_sent, anomaly, reason, streak = sentinel_mod.verdict(
+                sent_state, loss, gnorm, finite, cfg.sentinel)
+            gate = jnp.logical_not(anomaly)
 
         if self._offload_mode == "cpu":
             new_p_leaves, new_opt = self._offload_group_walk(
                 jax.tree_util.tree_leaves(params), opt_state,
-                jax.tree_util.tree_leaves(grads), lr, finite)
+                jax.tree_util.tree_leaves(grads), lr, gate)
             new_params = jax.tree_util.tree_unflatten(
                 self._param_treedef, new_p_leaves)
         else:
             updates, new_opt = self.optimizer.update(grads, opt_state, params)
             updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
             new_params = optax.apply_updates(params, updates)
-            new_params = _tree_select(finite, new_params, params)
-            new_opt = _tree_select(finite, new_opt, opt_state)
+            new_params = _tree_select(gate, new_params, params)
+            new_opt = _tree_select(gate, new_opt, opt_state)
         new_scale = precision.update_loss_scale(scale_state, finite, cfg.fp16)
         metrics = {
             "grad_norm": gnorm,
@@ -821,6 +933,11 @@ class Engine:
             "loss_scale": scale_state.scale,
             "skipped": jnp.logical_not(finite),
         }
+        if sent_state is not None:
+            metrics["anomalous"] = anomaly
+            metrics["anomaly_reason"] = reason
+            metrics["skip_streak"] = streak
+            return new_params, new_opt, new_scale, metrics, new_sent
         return new_params, new_opt, new_scale, metrics
 
     def _offload_group_walk(self, p_leaves, opt_groups, g_leaves, lr, finite,
@@ -929,6 +1046,24 @@ class Engine:
         if (self.topo.size("pipeline") > 1
                 and self.config.pipeline.schedule == "1f1b"):
             return self._build_train_batch_fn_1f1b()
+
+        if self._sentinel is not None:
+            # sentinel variant: the rolling-stats state rides the step like
+            # LossScaleState (donated, advanced in-program) and the verdict
+            # fuses into the update tail — same program count, no extra
+            # dispatches, no extra syncs
+            def sent_batch_fn(params, opt_state, scale_state, step, base_rng,
+                              batch, sent_state):
+                loss, acc = self._gas_grads(
+                    params, scale_state, step, base_rng, batch)
+                new_params, new_opt, new_scale, metrics, new_sent = \
+                    self._update(
+                        params, opt_state, scale_state, acc, float(self.gas),
+                        step, loss=loss, sent_state=sent_state)
+                metrics["loss"] = loss
+                return new_params, new_opt, new_scale, metrics, new_sent
+
+            return jax.jit(sent_batch_fn, donate_argnums=(0, 1, 2, 6))
 
         def train_batch_fn(params, opt_state, scale_state, step, base_rng, batch):
             loss, acc = self._gas_grads(params, scale_state, step, base_rng, batch)
@@ -1599,6 +1734,8 @@ class Engine:
                 scope.note_phase("data_wait", _dw0, time.perf_counter())
         if self.config.debug.sanity_checks:
             self._sanity_check_batch(batch)
+        if self._sentinel is not None or self._fault_injector.enabled:
+            batch = self._sentinel_pre_step(batch)
         self._step_miss0 = (self._jit_miss_count()
                             if self.telemetry.enabled else None)
         self.step_tracer.before_step(self.global_steps)
@@ -1654,6 +1791,17 @@ class Engine:
                     jnp.int32(self.global_steps), self._train_rng, dev_batch,
                     self._qgrad_error,
                 )
+            elif self._sentinel is not None:
+                (self.params, self.opt_state, self.scale_state, metrics,
+                 self._sent_state) = self._train_batch_jit(
+                    self.params,
+                    self.opt_state,
+                    self.scale_state,
+                    jnp.int32(self.global_steps),
+                    self._train_rng,
+                    dev_batch,
+                    self._sent_state,
+                )
             else:
                 self.params, self.opt_state, self.scale_state, metrics = \
                     self._train_batch_jit(
@@ -1684,6 +1832,25 @@ class Engine:
                     "gas": self.gas,
                 })
             raise
+        if self._sentinel is not None:
+            try:
+                if self._watchdog_timeout > 0:
+                    # dispatch watchdog: fence THIS step under a deadline.
+                    # Settling every step trades away the async pipeline's
+                    # overlap (microscope-style, like stepscope) — the
+                    # deadline is meaningless against a fence that lags
+                    # _max_inflight steps behind the wedge.
+                    sentinel_mod.watched_call(
+                        lambda: (self._fault_injector.fire(
+                            self._faults.POINT_TRAIN_DISPATCH),
+                            jax.block_until_ready(metrics["loss"])),
+                        self._watchdog_timeout)
+                elif self._fault_injector.enabled:
+                    self._fault_injector.fire(self._faults.POINT_TRAIN_DISPATCH)
+            except sentinel_mod.TrainingWedgeError as e:
+                return self._handle_wedge(e)
+        elif self._fault_injector.enabled:
+            self._fault_injector.fire(self._faults.POINT_TRAIN_DISPATCH)
         # NO per-step device sync here: over a tunneled TPU each host<->device
         # round trip costs more than the update tail; steps pipeline and Python
         # overhead hides under device compute. _after_step syncs only when a
@@ -1703,6 +1870,11 @@ class Engine:
         self.tput_timer.stop(global_step=True, exclude=self._step_recompiled())
         self._after_step(metrics)
         self.micro_steps += self.gas
+        if self._sentinel is not None:
+            # AFTER the step counters: a rollback in here restores them from
+            # the manifest and must not be clobbered by this step's
+            # bookkeeping
+            self._sentinel_post_step()
         return metrics["loss"]
 
     def forward(self, batch: dict):
@@ -1863,18 +2035,23 @@ class Engine:
         self.global_samples += int(self.config.train_batch_size or 0)
         # accumulate skips on-device (async); synced lazily by .skipped_steps
         self._skip_dev = self._skip_dev + metrics["skipped"].astype(jnp.int32)
-        # fp16 dynamic loss scaling wants per-step overflow visibility (and its
-        # tests assert the skip log); bf16 runs stay fully async.
-        if self.config.fp16.enabled and bool(metrics["skipped"]):
+        self.lr_scheduler.step()
+        self._last_metrics = metrics  # device arrays; fetched on demand
+        if self.monitor.enabled or self.telemetry.enabled:
+            self._last_metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        # fp16 per-step overflow visibility WITHOUT a dedicated device sync:
+        # the log reads the skip flag only when a consumer (monitor /
+        # telemetry) already paid the host fetch above. Otherwise the async
+        # skip counter + the steps_per_print settle report skips in
+        # aggregate — fp16 and bf16 steady state both stay fully async.
+        if (self.config.fp16.enabled
+                and isinstance(self._last_metrics["skipped"], np.ndarray)
+                and bool(self._last_metrics["skipped"])):
             log_dist(
                 f"step {self.global_steps}: overflow, skipping update "
                 f"(loss_scale -> {float(self.scale_state.scale)})",
                 ranks=[0],
             )
-        self.lr_scheduler.step()
-        self._last_metrics = metrics  # device arrays; fetched on demand
-        if self.monitor.enabled or self.telemetry.enabled:
-            self._last_metrics = {k: np.asarray(v) for k, v in metrics.items()}
         if self.telemetry.enabled:
             self._emit_step_telemetry(self._last_metrics)
         if self.monitor.enabled:
@@ -1909,7 +2086,217 @@ class Engine:
                 # symmetric settle point on every host: safe spot for the
                 # straggler-skew allgather
                 self.stepscope.refresh_skew()
+        if self._heartbeat is not None:
+            # liveness beacon, written HERE (training thread, step boundary)
+            # and never from a helper thread: a wedged dispatch must stop
+            # the beat so the elastic agent's staleness poll sees it
+            self._heartbeat.beat(self.global_steps)
         self.step_tracer.after_step(self.global_steps - 1)
+
+    # ------------------------------------------------------------------ sentinel
+    def _sentinel_pre_step(self, batch):
+        """Fingerprint the step's microbatches and consult the train.grads /
+        data.batch fault seams (serving/faults.py directive kinds). Returns
+        the (possibly poisoned) batch — injection rides a ``__loss_mult__``
+        key consumed inside the grad tape (``_microbatch_grads``), so the
+        loss AND its gradients blow up together like a real poisoned batch.
+        Only called when the sentinel or the fault injector is enabled."""
+        gas = self.gas
+        lead = int(np.asarray(next(iter(batch.values()))).shape[0])
+        if lead % gas == 0:
+            # per-microbatch content fingerprints, computed exactly as the
+            # quarantining loaders will see the batches (concatenate here /
+            # re-split there round-trips the arrays bit-identically)
+            fps = []
+            for i in range(gas):
+                mb = {}
+                for k, v in batch.items():
+                    v = np.asarray(v)
+                    mb[k] = v.reshape(
+                        (gas, v.shape[0] // gas) + v.shape[1:])[i]
+                fps.append(sentinel_mod.batch_fingerprint(mb))
+            self._last_batch_fps = fps
+        inj = self._fault_injector
+        if not inj.enabled:
+            return batch
+        directive = inj.fire(self._faults.POINT_TRAIN_GRADS)
+        if directive is None:
+            for fp in self._last_batch_fps:
+                directive = inj.fire(self._faults.POINT_DATA_BATCH,
+                                     request_id=fp)
+                if directive is not None:
+                    break
+        if directive is None:
+            return batch
+        mult = (float("nan") if directive == "nan-grads"
+                else sentinel_mod.SPIKE_LOSS_MULT)
+        log_dist(f"fault injection: {directive} directive at step "
+                 f"{self.global_steps} (loss x {mult})", ranks=[0])
+        batch = dict(batch)
+        batch["__loss_mult__"] = np.full((lead,), mult, np.float32)
+        return batch
+
+    def _sentinel_post_step(self):
+        """The policy half of the sentinel: settle this step's verdict and
+        walk the escalation ladder. This read is the ONE documented host
+        sync the enabled sentinel adds per step — detection itself ran
+        inside the fused program."""
+        pol = self._sentinel
+        cfg = self.config.sentinel
+        m = self._last_metrics
+        if not bool(m["anomalous"]):
+            pol.tick()
+            return
+        reason = int(m["anomaly_reason"])
+        streak = int(m["skip_streak"])
+        if (self.config.fp16.enabled
+                and reason == sentinel_mod.REASON_NONFINITE
+                and streak < cfg.max_consecutive_skips):
+            # a routine fp16 overflow is the loss scaler's business, not
+            # the ladder's — only a skip STREAK the scaler fails to adapt
+            # away (or a spike, or nonfinite grads without dynamic scaling)
+            # counts as a strike
+            pol.tick()
+            return
+        names = sentinel_mod.reason_names(reason)
+        fps = list(self._last_batch_fps)
+        if self.telemetry.enabled:
+            ctr = self.telemetry.counter(
+                "sentinel_anomalies_total",
+                "anomalous training steps flagged by the sentinel")
+            for n in names:
+                ctr.inc(reason=n)
+        tag = None
+        ckpt_dir = self._sentinel_ckpt_dir()
+        if ckpt_dir:
+            from deepspeed_tpu.checkpoint.engine import latest_tag
+
+            tag = latest_tag(ckpt_dir)
+        action = pol.observe(reason, fps, latest_tag=tag)
+        self._apply_quarantine_to_loader()
+        ctx = {
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "reason": names,
+            "skip_streak": streak,
+            "loss": float(m["loss"]),
+            "grad_norm": float(m["grad_norm"]),
+            "fingerprints": fps,
+            "quarantined": list(pol.quarantined),
+            "strikes_in_window": pol.strikes_in_window,
+            "action": action,
+        }
+        log_dist(
+            f"sentinel: anomalous step {self.global_steps - 1} "
+            f"({'+'.join(names)}; update skipped) -> {action}", ranks=[0])
+        path = sentinel_mod.write_forensics(
+            cfg.report_dir, action.replace("-", "_"), ctx)
+        if action == "rollback":
+            self._sentinel_rollback(ctx)
+        elif action == "reduce-lr":
+            self._sentinel_lr_backoff()
+        elif action == "halt":
+            raise sentinel_mod.DivergenceHaltError(
+                f"sentinel: third strike at step {self.global_steps - 1} "
+                f"({'+'.join(names)}) — halting per "
+                "sentinel.on_third_strike='halt'", report=path)
+
+    def _sentinel_ckpt_dir(self) -> str | None:
+        return self.config.sentinel.checkpoint_dir or self._last_save_dir
+
+    def _apply_quarantine_to_loader(self) -> None:
+        dl = self.training_dataloader
+        pol = self._sentinel
+        if (pol is not None and pol.quarantined and dl is not None
+                and hasattr(dl, "quarantine")):
+            dl.quarantine(pol.quarantined)
+
+    def _sentinel_rollback(self, ctx: dict) -> None:
+        """Restore the tag pinned at strike 1 (pre-anomaly — a later save
+        would bake in the batch-stream misalignment the skipped step caused)
+        and replay; the loaders skip the quarantined batches, so the
+        stitched trajectory matches a clean run that never saw them."""
+        pol = self._sentinel
+        cfg = self.config.sentinel
+        ckpt_dir = self._sentinel_ckpt_dir()
+        tag = pol.rollback_tag
+        if not ckpt_dir or tag is None:
+            path = sentinel_mod.write_forensics(cfg.report_dir, "halt", {
+                **ctx, "error": "rollback requested but no checkpoint "
+                "is available"})
+            raise sentinel_mod.DivergenceHaltError(
+                "sentinel: rollback requested but no verified checkpoint is "
+                "available (set sentinel.checkpoint_dir or save one first)",
+                report=path)
+        log_dist(f"sentinel: rolling back to checkpoint {tag!r}; replaying "
+                 "with quarantined batches skipped", ranks=[0])
+        t0 = time.perf_counter()
+        self.load_checkpoint(ckpt_dir, tag=tag)
+        dur = time.perf_counter() - t0
+        pol.rollbacks += 1
+        self.train_rollbacks += 1
+        if self.telemetry.enabled:
+            self.telemetry.counter(
+                "train_rollbacks_total",
+                "sentinel rollback-and-replay restores").inc()
+        if self.stepscope.enabled:
+            # goodput ledger: healing time is overhead, attributed to its
+            # own category (the load also appears under "checkpoint")
+            self.stepscope.note_overhead("rollback", dur)
+
+    def _sentinel_lr_backoff(self) -> None:
+        pol = self._sentinel
+        cfg = self.config.sentinel
+        self._lr_scale *= float(cfg.lr_backoff)
+        pol.lr_backoffs += 1
+        # the scale folds in at trace time: rebuild the step programs
+        self._train_batch_jit = None
+        self._warm_batch_jit = None
+        self._ltd_jits = {}
+        if self.telemetry.enabled:
+            self.telemetry.counter(
+                "sentinel_lr_backoffs_total",
+                "sentinel third-strike LR reductions").inc()
+        log_dist(f"sentinel: third strike -> lr backoff x{cfg.lr_backoff:g} "
+                 f"(cumulative scale {self._lr_scale:g})", ranks=[0])
+
+    def _handle_wedge(self, err):
+        """Dispatch-fence timeout: the step may never settle, so none of its
+        results can be trusted or waited on. Record forensics, abandon the
+        in-flight window, and roll back; halt when the window's wedge budget
+        or the checkpoint supply is exhausted."""
+        pol = self._sentinel
+        cfg = self.config.sentinel
+        if self.telemetry.enabled:
+            self.telemetry.counter(
+                "train_wedge_timeouts_total",
+                "training dispatch fences past the watchdog deadline").inc()
+        action = pol.observe_wedge()
+        ckpt_dir = self._sentinel_ckpt_dir()
+        tag = pol.rollback_tag
+        if ckpt_dir and tag is None:
+            from deepspeed_tpu.checkpoint.engine import latest_tag
+
+            tag = latest_tag(ckpt_dir)
+        ctx = {
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "reason": ["wedge"],
+            "timeout_s": self._watchdog_timeout,
+            "error": str(err),
+            "action": action,
+        }
+        path = sentinel_mod.write_forensics(cfg.report_dir, "wedge", ctx)
+        log_dist(f"sentinel: {err} -> {action}", ranks=[0])
+        if action == "rollback" and ckpt_dir and tag is not None:
+            self._inflight = []  # the wedged futures must never be awaited
+            pol.rollback_tag = tag
+            self._sentinel_rollback(ctx)
+            return float("nan")  # the wedged step's loss is unknowable
+        raise sentinel_mod.DivergenceHaltError(
+            f"sentinel: training dispatch wedged past "
+            f"{self._watchdog_timeout:g}s and no rollback is available "
+            f"(action {action!r})", report=path) from err
 
     def _emit_step_telemetry(self, vals: dict) -> None:
         """Per-step span + gauges + HBM watermark (telemetry enabled only).
@@ -2039,6 +2426,7 @@ class Engine:
         inj = _faults.get_fault_injector()
         ckpt_t0 = time.perf_counter()
         tag = tag or f"global_step{self.global_steps}"
+        self._last_save_dir = save_dir  # sentinel rollback target default
         stage_dir = ckpt.staging_dir(save_dir, str(tag))
         manifest = {
             "tag": tag,
@@ -2365,6 +2753,13 @@ class Engine:
             self.training_dataloader.load_state_dict(dl_state)
         if self._zenflow:
             self._zf_reset_transients()
+        if self._sentinel is not None:
+            # the rolling stats describe a trajectory position that no
+            # longer exists: restart them at the restored step, and re-skip
+            # the quarantined batches on the freshly positioned loader (the
+            # manifest predates the quarantine)
+            self._sent_state = sentinel_mod.init_state(self.config.sentinel)
+            self._apply_quarantine_to_loader()
         log_dist(
             f"loaded checkpoint {ckpt_dir} (saved at world_size="
             f"{manifest['world_size']}, now {self.topo.world_size})",

@@ -31,6 +31,19 @@ Fault kinds:
   (models the XLA allocator failing a device allocation; the memory
   ledger's OOM forensics and the watchdog's degradation hint key on the
   status text, exactly as they would for a real PJRT OOM).
+- ``wedge`` — sleep ``delay_s`` and continue (models a stuck device
+  program / transfer that never surfaces an error: the training loop's
+  heartbeat goes stale and the dispatch watchdog's deadline fires —
+  unlike ``hang`` this kind raises nothing itself).
+- ``nan-grads`` / ``loss-spike`` / ``poison-batch`` — **directive** kinds:
+  ``fire()`` returns the kind string instead of raising, and the training
+  seam perturbs the step accordingly (the engine folds a loss multiplier
+  into the batch: NaN for ``nan-grads``, a large finite factor for
+  ``loss-spike``/``poison-batch``). ``poison-batch`` is typically armed
+  with ``request_id`` = a batch fingerprint at the ``data.batch`` seam so
+  the poison is a property of the *data* — once the sentinel quarantines
+  that fingerprint the fault can never fire again, exactly like a bad
+  shard dropped from the stream.
 
 ``classify_transient`` is the shared error taxonomy used by the dispatch
 watchdog (inference/ragged.py) and the router breaker: injected transient
@@ -66,6 +79,12 @@ POINT_CKPT_COMMIT = "ckpt.commit"    # manifest sealed, before dir promote
 POINT_CKPT_LATEST = "ckpt.latest"    # latest-pointer update
 POINT_CKPT_LOAD = "ckpt.load"        # load/verify entry
 
+# Training-step seams (runtime/engine.py train_batch + runtime/sentinel.py):
+# the divergence/liveness faults the self-healing ladder must survive.
+POINT_TRAIN_DISPATCH = "train.dispatch"  # fused train step launch/fence
+POINT_TRAIN_GRADS = "train.grads"        # grad computation (transient anomaly)
+POINT_DATA_BATCH = "data.batch"          # batch admission (content-keyed)
+
 POINTS = (
     POINT_DISPATCH,
     POINT_H2D,
@@ -78,7 +97,14 @@ POINTS = (
     POINT_CKPT_COMMIT,
     POINT_CKPT_LATEST,
     POINT_CKPT_LOAD,
+    POINT_TRAIN_DISPATCH,
+    POINT_TRAIN_GRADS,
+    POINT_DATA_BATCH,
 )
+
+# Kinds whose firing returns the kind string to the seam (which applies the
+# perturbation itself) instead of raising/sleeping here.
+DIRECTIVE_KINDS = ("nan-grads", "loss-spike", "poison-batch")
 
 
 class FaultError(RuntimeError):
@@ -123,7 +149,8 @@ class FaultSpec:
             raise ValueError(
                 f"unknown fault point {self.point!r} (known: {POINTS})")
         if self.kind not in ("raise", "hang", "latency", "truncate",
-                             "corrupt-bytes", "kill", "oom"):
+                             "corrupt-bytes", "kill", "oom", "wedge",
+                             *DIRECTIVE_KINDS):
             raise ValueError(f"unknown fault kind {self.kind!r}")
 
 
@@ -174,12 +201,15 @@ class FaultInjector:
 
     # ------------------------------------------------------------- firing
     def fire(self, point: str, request_id: str | None = None,
-             path: str | None = None) -> None:
+             path: str | None = None) -> str | None:
         """Called by production code at the named seam. No-op unless a
         matching armed spec elects this hit. ``path`` names the file the
-        seam just touched, for the file-mutating kinds."""
+        seam just touched, for the file-mutating kinds. Directive kinds
+        (``nan-grads`` / ``loss-spike`` / ``poison-batch``) return the kind
+        string so the seam applies the perturbation; every other kind
+        returns ``None`` (callers that ignore the return are unaffected)."""
         if not self.enabled:
-            return
+            return None
         spec = None
         with self._lock:
             for s in self._specs:
@@ -202,7 +232,7 @@ class FaultInjector:
                 spec = s
                 break
         if spec is None:
-            return
+            return None
         tel = get_telemetry()
         if tel.enabled:
             tel.counter(
@@ -212,9 +242,16 @@ class FaultInjector:
         msg = spec.message or (
             f"injected {spec.kind} fault at {point}"
             f" (hit {spec.hits}, firing {spec.fired})")
+        if spec.kind in DIRECTIVE_KINDS:
+            return spec.kind
         if spec.kind == "latency":
             time.sleep(spec.delay_s)
-            return
+            return None
+        if spec.kind == "wedge":
+            # a stuck dispatch: the seam simply stops making progress — no
+            # error to catch, only a stale heartbeat / watchdog deadline
+            time.sleep(spec.delay_s)
+            return None
         if spec.kind == "kill":
             # a preemption landing exactly at this seam: no cleanup, no
             # flush, no atexit — the process is simply gone

@@ -60,6 +60,93 @@ class TestElasticAgent:
         with pytest.raises(ValueError, match="no admissible"):
             agent.admissible_world_sizes()
 
+    def test_sigkilled_preemption_restarts(self, tmp_path):
+        """A SIGKILL'd worker (negative returncode — a preempted host) must
+        take the same restart branch as a nonzero exit."""
+        marker = tmp_path / "killed_once"
+
+        def make(rank, world):
+            code = f"""
+import os, signal, time
+open({str(tmp_path)!r} + f"/ran_{{os.environ['RANK']}}_{{os.environ['WORLD_SIZE']}}", "w").close()
+if not os.path.exists({str(marker)!r}):
+    open({str(marker)!r}, "w").close()
+    os.kill(os.getpid(), signal.SIGKILL)
+time.sleep(0.2)
+"""
+            env = dict(os.environ, RANK=str(rank), WORLD_SIZE=str(world))
+            return WorkerSpec(cmd=[sys.executable, "-c", code], env=env)
+
+        agent = ElasticAgent(
+            target_batch_size=8, micro_batch_candidates=[2, 4, 8],
+            make_worker=make, max_world_size=2, min_world_size=1,
+            poll_interval=0.1)
+        assert agent.run() == 0
+        assert agent.restarts == 1
+        assert (tmp_path / "ran_0_2").exists()
+        assert (tmp_path / "ran_0_1").exists()  # relaunched smaller
+
+    def test_heartbeat_stale_worker_killed(self, tmp_path):
+        """A worker that stays alive but never beats past the grace window
+        is wedged: the agent SIGKILLs it and the relaunch completes."""
+        hb_dir = tmp_path / "state"
+        hb_dir.mkdir()
+        marker = tmp_path / "wedged_once"
+
+        def make(rank, world):
+            code = f"""
+import json, os, time
+hb = os.path.join({str(hb_dir)!r}, "heartbeat_0.json")
+if not os.path.exists({str(marker)!r}):
+    open({str(marker)!r}, "w").close()
+    time.sleep(600)  # wedged-but-alive: no beacon ever written
+with open(hb, "w") as f:
+    json.dump({{"step": 1}}, f)
+time.sleep(0.2)
+"""
+            return WorkerSpec(cmd=[sys.executable, "-c", code],
+                              env=dict(os.environ))
+
+        agent = ElasticAgent(
+            target_batch_size=4, micro_batch_candidates=[4],
+            make_worker=make, max_world_size=1, min_world_size=1,
+            poll_interval=0.1, heartbeat_dir=str(hb_dir),
+            heartbeat_timeout=0.5, heartbeat_grace=1.5)
+        assert agent.run() == 0
+        assert agent.heartbeat_kills == 1
+        assert agent.restarts == 1
+
+    def test_sweep_stale_state(self, tmp_path):
+        """Launch sweeps per-incarnation heartbeat beacons and torn
+        quarantine files; a valid quarantine list (healing memory) stays."""
+        hb_dir = tmp_path / "state"
+        hb_dir.mkdir()
+        (hb_dir / "heartbeat_0.json").write_text('{"step": 3}')
+        (hb_dir / "heartbeat_1.json").write_text("torn{")
+        (hb_dir / "quarantine.json").write_text('["abc123"]')
+
+        agent = ElasticAgent(
+            target_batch_size=4, micro_batch_candidates=[4],
+            make_worker=lambda r, w: WorkerSpec(
+                cmd=[sys.executable, "-c", "pass"], env=dict(os.environ)),
+            max_world_size=1, poll_interval=0.1,
+            heartbeat_dir=str(hb_dir), heartbeat_timeout=5.0)
+        assert agent.run() == 0
+        assert not (hb_dir / "heartbeat_0.json").exists()
+        assert not (hb_dir / "heartbeat_1.json").exists()
+        assert (hb_dir / "quarantine.json").read_text() == '["abc123"]'
+
+        # torn quarantine is removed at the next launch
+        (hb_dir / "quarantine.json").write_text('["abc123"')  # torn write
+        agent2 = ElasticAgent(
+            target_batch_size=4, micro_batch_candidates=[4],
+            make_worker=lambda r, w: WorkerSpec(
+                cmd=[sys.executable, "-c", "pass"], env=dict(os.environ)),
+            max_world_size=1, poll_interval=0.1,
+            heartbeat_dir=str(hb_dir), heartbeat_timeout=5.0)
+        assert agent2.run() == 0
+        assert not (hb_dir / "quarantine.json").exists()
+
 
 class TestPreemptionHandler:
     def test_sigterm_checkpoints_and_stops(self, tmp_path):

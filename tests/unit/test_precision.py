@@ -53,6 +53,41 @@ def test_growth_after_window():
     assert int(st.hysteresis) == 2  # refilled
 
 
+def test_min_scale_floor_under_sustained_storm():
+    """A sustained overflow storm parks the scale AT min_loss_scale and
+    never pushes it below (or to zero): every post-floor overflow is a
+    no-op on the scale, not a further halving."""
+    cfg = _cfg(initial_scale_power=3, hysteresis=1, min_loss_scale=2.0)
+    st = precision.init_loss_scale(cfg)
+    seen = []
+    for _ in range(20):
+        st = precision.update_loss_scale(st, jnp.asarray(False), cfg)
+        seen.append(float(st.scale))
+    assert seen[-1] == 2.0
+    assert min(seen) == 2.0  # floor held through the whole storm
+    assert int(st.good_steps) == 0
+
+
+def test_growth_window_resets_on_single_overflow():
+    """One overflow inside the growth window zeroes good_steps: growth
+    needs a FULL window of consecutive clean steps afterwards."""
+    cfg = _cfg(initial_scale_power=4, loss_scale_window=4, hysteresis=1)
+    st = precision.init_loss_scale(cfg)
+    for _ in range(3):  # one short of the window
+        st = precision.update_loss_scale(st, jnp.asarray(True), cfg)
+    assert int(st.good_steps) == 3
+    st = precision.update_loss_scale(st, jnp.asarray(False), cfg)
+    assert int(st.good_steps) == 0  # window restarted
+    assert float(st.scale) == 8.0  # hysteresis=1: the overflow also halved
+    # three clean steps are NOT enough to grow again...
+    for _ in range(3):
+        st = precision.update_loss_scale(st, jnp.asarray(True), cfg)
+    assert float(st.scale) == 8.0
+    # ...the fourth completes the fresh window
+    st = precision.update_loss_scale(st, jnp.asarray(True), cfg)
+    assert float(st.scale) == 16.0
+
+
 def test_grads_finite():
     good = {"a": jnp.ones((3,)), "b": {"c": jnp.zeros((2, 2))}}
     assert bool(precision.grads_finite(good))
