@@ -726,6 +726,9 @@ def train_anatomy_main():
     batch = int(e.get("BENCH_ANATOMY_BATCH", 8))
     steps = int(e.get("BENCH_ANATOMY_STEPS", 8))
     gas = int(e.get("BENCH_ANATOMY_GAS", 2))
+    # device-capture window every N steps (0 disables); the default lands
+    # one window inside the default step budget, past warmup/compile
+    profile_interval = int(e.get("BENCH_ANATOMY_PROFILE_INTERVAL", 4))
 
     runs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "runs")
     os.makedirs(runs_dir, exist_ok=True)
@@ -741,7 +744,11 @@ def train_anatomy_main():
             "enabled": True,
             "jsonl_path": os.path.join(runs_dir,
                                        "BENCH_train_anatomy_telemetry.jsonl"),
-            "stepscope": {"enabled": True},
+            "stepscope": {
+                "enabled": True,
+                "profile_interval_steps": profile_interval,
+                "profile_dir": os.path.join(runs_dir, "devprof"),
+            },
         },
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -763,6 +770,13 @@ def train_anatomy_main():
         engine.save_checkpoint(ckpt_dir)
     summary = engine.stepscope.summary()
 
+    # measured-vs-estimated overlap: the estimate comes from stepscope's
+    # analytic wire-time model, the measured value from the devprof capture
+    # window's classified device timeline (None when no window completed)
+    devprof_last = engine.devprof_last
+    devprof_summary = (devprof_last or {}).get("summary")
+    measured_overlap = (devprof_summary or {}).get("overlap_fraction_measured")
+
     trace_path = os.path.join(runs_dir, "BENCH_train_anatomy_trace.json")
     trace = TELEMETRY.dump_trace(trace_path)
     events = trace.get("traceEvents", [])
@@ -772,6 +786,12 @@ def train_anatomy_main():
                    if str(ev.get("name", "")).startswith("train/phase/")]
     nested = [ev for ev in phase_spans
               if ev.get("args", {}).get("parent_id") in step_ids]
+    phase_ids = {ev.get("args", {}).get("span_id") for ev in phase_spans}
+    host_ids = step_ids | phase_ids
+    device_spans = [ev for ev in events
+                    if str(ev.get("name", "")).startswith("device/")]
+    device_nested = [ev for ev in device_spans
+                     if ev.get("args", {}).get("parent_id") in host_ids]
     prom = TELEMETRY.registry.render_prometheus()
 
     engine.destroy()
@@ -781,11 +801,26 @@ def train_anatomy_main():
         "steps": steps,
         "train_batch_size": batch,
         "gas": gas,
+        "overlap_fraction_estimate": summary.get("overlap_fraction"),
+        "overlap_fraction_measured": measured_overlap,
+        "devprof": {
+            "enabled": profile_interval > 0,
+            "summary": devprof_summary,
+            "merged_spans": (devprof_last or {}).get("merged_spans", 0),
+            "op_count": (devprof_summary or {}).get("op_count", 0),
+        },
         "trace_path": trace_path,
         "trace_step_spans": len(step_spans),
         "trace_phase_spans": len(phase_spans),
         "trace_nested_phase_spans": len(nested),
+        "trace_device_spans": len(device_spans),
+        "trace_nested_device_spans": len(device_nested),
         "scrape_has_overlap": "train_overlap_fraction" in prom,
+        "scrape_has_estimate_overlap":
+            'train_overlap_fraction{source="estimate"}' in prom,
+        "scrape_has_measured_overlap":
+            'train_overlap_fraction{source="measured"}' in prom,
+        "scrape_has_devprof_capture": "devprof_captures_total" in prom,
         "scrape_has_goodput": "train_goodput" in prom,
         "scrape_has_phase_histogram": "step_phase_seconds" in prom,
         "scrape_has_flops_source": "train_flops_source" in prom,

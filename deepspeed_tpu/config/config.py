@@ -489,10 +489,17 @@ class TelemetryConfig(ConfigBase):
     monitor_sink: bool = False
     # flush the file sink every N emitted records
     flush_interval_events: int = 100
-    # {enabled, interconnect_gbps, peak_tflops, use_cost_analysis}: training
-    # step anatomy (telemetry/stepscope.py) — per-phase decomposition spans,
+    # {enabled, interconnect_gbps, peak_tflops, use_cost_analysis,
+    # profile_interval_steps, profile_dir, profile_keep}: training step
+    # anatomy (telemetry/stepscope.py) — per-phase decomposition spans,
     # MFU attribution, overlap + goodput gauges. Enabling it settles every
     # step (microscope mode) and implies the trace ring on.
+    # profile_interval_steps > 0 additionally opens a device-timeline
+    # capture window (telemetry/devprof.py) every N steps: measured overlap
+    # / wire-time / idle metrics, device ops merged into the trace ring;
+    # capture dirs rotate under profile_dir (default runs/devprof, keep
+    # profile_keep=4 most recent). Capture-bearing steps are excluded from
+    # throughput and anatomy averages like recompile-bearing steps.
     stepscope: dict = field(default_factory=dict)
     # {enabled, census_interval_steps, drift_threshold, drift_consecutive,
     # report_dir} or bare true: HBM memory ledger (telemetry/memledger.py) —

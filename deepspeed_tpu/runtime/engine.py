@@ -24,6 +24,7 @@ TPU-native architecture (not a port):
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Iterator
 
@@ -489,6 +490,27 @@ class Engine:
             flops_source=self._flops_source,
         )
 
+        # device-timeline profiler (telemetry/devprof.py): bounded capture
+        # windows every profile_interval_steps steps, parsed into measured
+        # overlap / wire-time / idle metrics and merged into the trace ring.
+        # Requires stepscope (microscope mode settles the step so the window
+        # closes cleanly); off by default — the hot path only ever checks
+        # `self._devprof is not None`.
+        self._devprof = None
+        self._devprof_interval = 0
+        self._devprof_last = None
+        dp_interval = int(ss_opts.get("profile_interval_steps", 0) or 0)
+        if self.stepscope.enabled and dp_interval > 0:
+            from deepspeed_tpu.telemetry.devprof import DeviceProfiler
+
+            self._devprof_interval = dp_interval
+            self._devprof = DeviceProfiler(
+                self.telemetry,
+                out_dir=str(ss_opts.get("profile_dir")
+                            or os.path.join("runs", "devprof")),
+                keep=int(ss_opts.get("profile_keep", 4)),
+            )
+
         if (config.progressive_layer_drop.enabled
                 and not self.model_spec.supports_pld):
             raise ValueError(
@@ -776,6 +798,13 @@ class Engine:
     @property
     def gas(self) -> int:
         return int(self.config.gradient_accumulation_steps or 1)
+
+    @property
+    def devprof_last(self) -> dict | None:
+        """Parsed result of the most recent device-profile capture window
+        (summary + classified ops + merge count), or None before the first
+        window completes."""
+        return self._devprof_last
 
     def _grad_ns(self):
         return self.plan.grad_shardings
@@ -1390,7 +1419,9 @@ class Engine:
         self._inflight.append(metrics["loss"])
         if len(self._inflight) > self._max_inflight:
             jax.block_until_ready(self._inflight.pop(0))
-        self.tput_timer.stop(global_step=True, exclude=self._step_recompiled())
+        self.tput_timer.stop(
+            global_step=True,
+            exclude=self._step_recompiled() or self._devprof_capturing())
         self._after_step(metrics)
         self.micro_steps += self.gas
         return metrics["loss"]
@@ -1474,7 +1505,9 @@ class Engine:
             "loss_scale": step_scale,
             "skipped": jnp.logical_not(finite_dev),
         }
-        self.tput_timer.stop(global_step=True, exclude=self._step_recompiled())
+        self.tput_timer.stop(
+            global_step=True,
+            exclude=self._step_recompiled() or self._devprof_capturing())
         self._after_step(metrics)
         self.micro_steps += self.gas
         return metrics["loss"]
@@ -1651,7 +1684,9 @@ class Engine:
         self._inflight.append(metrics["loss"])
         if len(self._inflight) > self._max_inflight:
             jax.block_until_ready(self._inflight.pop(0))
-        self.tput_timer.stop(global_step=True, exclude=self._step_recompiled())
+        self.tput_timer.stop(
+            global_step=True,
+            exclude=self._step_recompiled() or self._devprof_capturing())
         self._after_step(metrics)
         self.micro_steps += self.gas
         return metrics["loss"]
@@ -1722,6 +1757,8 @@ class Engine:
         scope = self.stepscope if self.stepscope.enabled else None
         if scope is not None:
             scope.begin_step(self.global_steps)
+            if self._devprof is not None:
+                self._devprof_maybe_begin()
         if batch is None:
             if data_iter is None:
                 if self.training_dataloader is None:
@@ -1867,7 +1904,9 @@ class Engine:
         self._inflight.append(metrics["loss"])
         if len(self._inflight) > self._max_inflight:
             jax.block_until_ready(self._inflight.pop(0))
-        self.tput_timer.stop(global_step=True, exclude=self._step_recompiled())
+        self.tput_timer.stop(
+            global_step=True,
+            exclude=self._step_recompiled() or self._devprof_capturing())
         self._after_step(metrics)
         self.micro_steps += self.gas
         if self._sentinel is not None:
@@ -1918,6 +1957,8 @@ class Engine:
                                 if self.telemetry.enabled else None)
             if scope is not None:
                 scope.begin_step(self.global_steps)
+                if self._devprof is not None:
+                    self._devprof_maybe_begin()
         if self._accum_jit is None:
             self._accum_jit = self._build_accum_fn()
         if self._acc_grads is None:
@@ -2026,11 +2067,34 @@ class Engine:
         if ids is not None and not np.issubdtype(np.asarray(ids).dtype, np.integer):
             raise ValueError("sanity: input_ids must be an integer array")
 
+    def _devprof_capturing(self) -> bool:
+        return self._devprof is not None and self._devprof.capturing
+
+    def _devprof_maybe_begin(self) -> None:
+        """Open a device-capture window when the step hits the interval.
+
+        Called right after ``begin_step`` so the window spans the whole step
+        (data wait, h2d, dispatch, settle). The window is closed and parsed
+        in ``_after_step``, which every step path funnels through.
+        """
+        if (not self._devprof.capturing
+                and self.global_steps > 0
+                and self.global_steps % self._devprof_interval == 0):
+            self._devprof.begin(tag="stepscope")
+
     def _after_step(self, metrics):
+        profiled = self._devprof is not None and self._devprof.capturing
+        if profiled:
+            # close the jax session before end_step so the capture stops at
+            # the settled step boundary; parse after end_step so the phase
+            # spans exist in the ring for the device-op merge to nest under
+            self._devprof.stop()
         if self.stepscope.enabled:
             # close the anatomy window (all paths funnel here); the recompile
             # share comes from the compile-listener delta since begin_step
-            self.stepscope.end_step(self.global_steps)
+            self.stepscope.end_step(self.global_steps, profiled=profiled)
+        if profiled:
+            self._devprof_last = self._devprof.finish(kind="train")
         self.global_steps += 1
         self.global_samples += int(self.config.train_batch_size or 0)
         # accumulate skips on-device (async); synced lazily by .skipped_steps

@@ -178,6 +178,10 @@ class EngineLoop:
         # starting a replacement thread
         self.crash_count = 0
         self.respawn_count = 0
+        # monotonically increasing count of successful engine steps; polled
+        # by devprof.capture_serving to bound /debug/profile windows in
+        # steps rather than wall time
+        self.steps = 0
         self._consec_crashes = 0
         self._lock = threading.Lock()
         self._inbox: list = []       # heap of (priority, seqno, req, stream)
@@ -552,6 +556,7 @@ class EngineLoop:
                     self._contain(e)
                 else:
                     self._consec_crashes = 0
+                    self.steps += 1
                 self._deliver()
                 self._publish_stats()
                 continue

@@ -193,6 +193,30 @@ def _make_handler(frontend: ServingFrontend):
                     self._send_json(200, {"enabled": False})
                 else:
                     self._send_json(200, led.debug_payload())
+            elif path == "/debug/profile":
+                # bounded device-timeline capture over ~N engine-loop steps
+                # (telemetry/devprof.py); one capture at a time per process
+                qs = parse_qs(query)
+                try:
+                    steps = int((qs.get("steps") or ["8"])[0])
+                    wait_s = float((qs.get("timeout_s") or ["5"])[0])
+                except ValueError:
+                    self._send_error_json(
+                        400, "steps and timeout_s must be numeric")
+                    return
+                steps = max(1, min(256, steps))
+                wait_s = max(0.1, min(30.0, wait_s))
+                from deepspeed_tpu.telemetry.devprof import capture_serving
+
+                loops, _ = router._snapshot()
+                res = capture_serving(loops, steps=steps, max_wait_s=wait_s,
+                                      telemetry=get_telemetry())
+                if res is None:
+                    self._send_error_json(
+                        409, "a profiler capture is already in progress",
+                        retry_after_s=wait_s)
+                else:
+                    self._send_json(200, res)
             else:
                 self._send_error_json(404, f"no route for {path}")
 

@@ -22,6 +22,14 @@ block; ``deepspeed_tpu.initialize`` wires the engine emit points.
 """
 
 from deepspeed_tpu.telemetry.core import TELEMETRY, Telemetry  # noqa: F401
+from deepspeed_tpu.telemetry.devprof import (  # noqa: F401
+    DeviceProfiler,
+    capture_serving,
+    classify_op,
+    derive_timeline,
+    merge_into_ring,
+    parse_chrome_trace,
+)
 from deepspeed_tpu.telemetry.memledger import (  # noqa: F401
     MemoryLedger,
     OWNERS as MEMORY_OWNERS,

@@ -151,6 +151,10 @@ class StepScope:
         self._checkpoint_s = 0.0
         self._overhead_s = 0.0   # all note_overhead time (excluded from warmup)
         self._warmup_s = 0.0
+        # capture-bearing steps (devprof windows): span-visible but excluded
+        # from every run average, like recompile-bearing steps
+        self._profiled_steps = 0
+        self._profiling_s = 0.0
         self._saw_step = False
         self._exposed_s = 0.0
         self._coll_s = 0.0
@@ -175,11 +179,12 @@ class StepScope:
             self._c_goodput = reg.counter(
                 "train_goodput_seconds_total",
                 "wall-clock by goodput category "
-                "(productive|recompile|checkpoint|warmup)")
+                "(productive|recompile|checkpoint|warmup|profiling)")
             self._g_overlap = reg.gauge(
                 "train_overlap_fraction",
-                "grad-collective time hidden under compute / total "
-                "estimated collective time")
+                "fraction of collective time hidden under compute "
+                "(source=estimate: analytic wire-time model; "
+                "source=measured: devprof device-timeline capture)")
             self._g_goodput = reg.gauge(
                 "train_goodput",
                 "productive step seconds / wall seconds since scope start")
@@ -200,7 +205,7 @@ class StepScope:
                 "step's highest HBM watermark, labeled by the phase whose "
                 "boundary observed it (which phase owns the peak)")
             # pre-set so a scrape sees the series before the first step
-            self._g_overlap.set(1.0)
+            self._g_overlap.set(1.0, source="estimate")
             self._g_goodput.set(0.0)
             self._g_skew.set(1.0)
 
@@ -265,8 +270,15 @@ class StepScope:
             return 0.0
         return self._compile_hist.sum(phase="backend_compile")
 
-    def end_step(self, step: int | None = None, **attrs) -> dict | None:
+    def end_step(self, step: int | None = None, profiled: bool = False,
+                 **attrs) -> dict | None:
         """Close the step: attribute the device window, emit spans/metrics.
+
+        ``profiled=True`` marks a capture-bearing step (a devprof window was
+        open): its spans are still emitted — the device-op merge needs host
+        phases to nest under — but the step is excluded from every run
+        average (phase histograms/totals, goodput, overlap, MFU, skew),
+        exactly like recompile-bearing steps are excluded from throughput.
 
         Returns the per-phase seconds dict (None when disabled/unstarted).
         """
@@ -323,14 +335,17 @@ class StepScope:
             step_ctx = TraceContext(self._trace_id, _new_span_id(), None)
         for name, a, b, attributed in spans:
             dur = b - a
-            self._phase_hist.observe(dur, phase=name)
-            self._phase_totals[name] = self._phase_totals.get(name, 0.0) + dur
+            if not profiled:
+                self._phase_hist.observe(dur, phase=name)
+                self._phase_totals[name] = (
+                    self._phase_totals.get(name, 0.0) + dur)
             if step_ctx is not None:
                 tracer.finish(
                     TraceContext(self._trace_id, _new_span_id(),
                                  step_ctx.span_id),
                     f"train/phase/{name}", a, b, phase=name,
-                    attributed=True if attributed else None)
+                    attributed=True if attributed else None,
+                    profiled=True if profiled else None)
 
         # per-phase HBM watermark deltas: each boundary sample is charged to
         # the phase that just ended, and the step's highest watermark names
@@ -345,6 +360,20 @@ class StepScope:
                     peak_phase, peak_bytes = name, m
                 prev = m
             self._g_peak_hbm.set(float(peak_bytes), phase=peak_phase)
+
+        if profiled:
+            # the profiler's own overhead (trace start/stop, device dumps)
+            # pollutes the wall; charge the whole step to a "profiling"
+            # goodput category and keep it out of every run average
+            self._profiled_steps += 1
+            self._profiling_s += total
+            self._c_goodput.inc(total, category="profiling")
+            if step_ctx is not None:
+                tracer.finish(step_ctx, "train/step", t0, t1, step=step,
+                              profiled=True, **attrs)
+            out = {n: b - a for n, a, b, _ in spans}
+            out["total"] = total
+            return out
 
         # goodput: a recompiling step is productive only for its non-compile
         # remainder
@@ -362,7 +391,7 @@ class StepScope:
         self._coll_s += est_coll_s
         overlap = self.overlap_fraction()
         goodput = self.goodput()
-        self._g_overlap.set(overlap)
+        self._g_overlap.set(overlap, source="estimate")
         self._g_goodput.set(goodput)
 
         model_flops = (3.0 * self.fwd_flops_per_step
@@ -485,6 +514,7 @@ class StepScope:
         return {
             "enabled": True,
             "steps": self._steps,
+            "profiled_steps": self._profiled_steps,
             "step_seconds_total": self._step_s,
             "step_seconds_mean": self._step_s / steps,
             "phase_seconds_total": phase_total,
@@ -502,6 +532,7 @@ class StepScope:
                 "recompile": self._recompile_s,
                 "checkpoint": self._checkpoint_s,
                 "warmup": self._warmup_s,
+                "profiling": self._profiling_s,
                 "wall": wall,
             },
             "mfu": mfu,

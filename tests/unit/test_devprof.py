@@ -1,0 +1,484 @@
+"""Device-timeline profiler (telemetry/devprof.py).
+
+Pins the PR acceptance criteria: the op classifier and interval-union
+overlap math against a checked-in synthetic trace fixture (no live profiler
+needed), a live-capture smoke on the CPU backend, windowed capture through
+the training engine with profiled steps excluded from stepscope's pinned
+invariants and the throughput average, ``/debug/profile`` end-to-end
+including concurrent-capture rejection, device-op span nesting in the
+merged Perfetto export, capture-dir rotation, and a zero-allocation hot
+path when profiling is not configured (tracemalloc-pinned like stepscope)."""
+
+import http.client
+import json
+import os
+import threading
+import time
+import tracemalloc
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.comm.topology import reset_topology
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.telemetry import TELEMETRY
+from deepspeed_tpu.telemetry.devprof import (
+    ANCHOR_NAME,
+    DeviceProfiler,
+    _union,
+    capture_serving,
+    classify_op,
+    derive_timeline,
+    load_trace_dir,
+    merge_into_ring,
+    op_family,
+    parse_chrome_trace,
+    shift_ops,
+)
+from deepspeed_tpu.telemetry.tracing import TraceContext, _new_span_id
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data",
+                       "devprof_synthetic_trace.json")
+
+
+def _fixture():
+    with open(FIXTURE) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------- classifier
+
+def test_classifier_families():
+    assert classify_op("all-reduce.1") == "collective"
+    assert classify_op("%all-gather-start.3") == "collective"
+    assert classify_op("reduce-scatter.7") == "collective"
+    assert classify_op("collective-permute-done.2") == "collective"
+    assert classify_op("psum.4") == "collective"
+    assert classify_op("fusion.12") == "compute"
+    assert classify_op("dot.3") == "compute"
+    assert classify_op("dynamic-update-slice.9") == "compute"
+    assert classify_op("copy-start.3") == "copy"
+    assert classify_op("copy.1") == "copy"
+    assert classify_op("MemcpyH2D") == "copy"
+    assert classify_op("MemcpyD2H") == "copy"
+    assert classify_op("infeed.1") == "infeed_outfeed"
+    assert classify_op("outfeed.2") == "infeed_outfeed"
+    # reduce-window must NOT hit the reduce-scatter collective family
+    assert classify_op("reduce-window.5") == "compute"
+
+
+def test_op_family_normalization():
+    assert op_family("%all-gather-start.3") == "all-gather"
+    assert op_family("fusion.12") == "fusion"
+    assert op_family("copy-done.2") == "copy"
+    assert op_family("MemcpyH2D") == "memcpyh2d"
+    assert op_family("reduce.8") == "reduce"
+
+
+# -------------------------------------------------------------------- parser
+
+def test_parse_synthetic_fixture():
+    ops, anchor_us = parse_chrome_trace(_fixture())
+    # 9 device ops: 2 via args.hlo_op, 7 via the device-pid "XLA Ops" rule;
+    # the anchor, host python spans, the "Steps" aggregate lane, and the
+    # zero-duration marker are all excluded
+    assert len(ops) == 9
+    assert anchor_us == pytest.approx(1000.0)
+    names = [o["name"] for o in ops]
+    assert "fusion.1" in names and "copy-start.2" in names
+    assert "train_step" not in names and "zero-dur-marker" not in names
+    by_cls = {}
+    for o in ops:
+        by_cls[o["cls"]] = by_cls.get(o["cls"], 0) + 1
+    assert by_cls == {"compute": 2, "collective": 2, "copy": 4,
+                      "infeed_outfeed": 1}
+    assert ops == sorted(ops, key=lambda o: o["t0"])
+
+
+def test_anchor_shift_aligns_clocks():
+    ops, anchor_us = parse_chrome_trace(_fixture())
+    t_anchor_host = 500.0  # pretend perf_counter at the anchor annotation
+    shift_ops(ops, t_anchor_host - anchor_us * 1e-6)
+    # fusion.1 starts at the same trace timestamp as the anchor -> lands
+    # exactly on the host-side anchor stamp
+    first = min(ops, key=lambda o: o["t0"])
+    assert first["name"] == "fusion.1"
+    assert first["t0"] == pytest.approx(500.0, abs=1e-9)
+    assert first["t1"] == pytest.approx(500.0 + 400e-6, abs=1e-9)
+
+
+# ------------------------------------------------------------- derived math
+
+def test_union_merges_overlapping_intervals():
+    assert _union([(1.0, 2.0), (1.5, 3.0), (4.0, 5.0), (5.0, 6.0)]) == [
+        (1.0, 3.0), (4.0, 6.0)]
+    assert _union([]) == []
+
+
+def test_overlap_math_exact():
+    ops, _ = parse_chrome_trace(_fixture())
+    s = derive_timeline(ops)
+    # compute union [1000,1400]+[1600,1800]us; all-reduce [1200,1500] overlaps
+    # 200us, all-gather [1700,1800] overlaps 100us -> 300/400 = 0.75
+    assert s["collective_seconds"] == pytest.approx(400e-6)
+    assert s["collective_overlapped_seconds"] == pytest.approx(300e-6)
+    assert s["overlap_fraction_measured"] == pytest.approx(0.75)
+    assert s["class_seconds"]["compute"] == pytest.approx(600e-6)
+    assert s["class_seconds"]["collective"] == pytest.approx(400e-6)
+    assert s["class_seconds"]["copy"] == pytest.approx(95e-6)
+    assert s["class_seconds"]["infeed_outfeed"] == pytest.approx(30e-6)
+    assert s["copy_seconds"]["h2d"] == pytest.approx(20e-6)
+    assert s["copy_seconds"]["d2h"] == pytest.approx(15e-6)
+    assert s["copy_seconds"]["device"] == pytest.approx(60e-6)
+    # busy union 825us over the [1000,1980]us window -> idle 155/980
+    assert s["window_s"] == pytest.approx(980e-6)
+    assert s["device_busy_s"] == pytest.approx(825e-6)
+    assert s["idle_fraction"] == pytest.approx(155.0 / 980.0)
+    top = {t["op"]: t for t in s["top_ops"]}
+    assert top["fusion"]["seconds"] == pytest.approx(600e-6)
+    assert top["fusion"]["count"] == 2
+    assert s["top_ops"][0]["op"] == "fusion"  # sorted by seconds desc
+    colls = {c["op"] for c in s["collectives"]}
+    assert colls == {"all-reduce", "all-gather"}
+
+
+def test_derive_empty_ops_is_vacuous():
+    s = derive_timeline([])
+    assert s["op_count"] == 0
+    assert s["overlap_fraction_measured"] == 1.0  # no wire time to expose
+    assert s["idle_fraction"] == 0.0
+    assert s["top_ops"] == []
+
+
+# ------------------------------------------------------------- ring merging
+
+def _host_span(tracer, name, t0, t1, parent=None, trace_id=None):
+    ctx = TraceContext(trace_id or uuid.uuid4().hex, _new_span_id(),
+                       parent.span_id if parent else None)
+    tracer.finish(ctx, name, t0, t1)
+    return ctx
+
+
+def test_merge_nests_under_smallest_host_span():
+    telemetry.configure(enabled=True, tracing=True)
+    tracer = TELEMETRY.tracer
+    step = _host_span(tracer, "train/step", 100.0, 101.0)
+    fwd = _host_span(tracer, "train/phase/forward", 100.0, 100.5,
+                     parent=step, trace_id=step.trace_id)
+    bwd = _host_span(tracer, "train/phase/backward", 100.5, 101.0,
+                     parent=step, trace_id=step.trace_id)
+    ops = [
+        {"name": "fusion.1", "family": "fusion", "cls": "compute",
+         "t0": 100.1, "t1": 100.3},
+        {"name": "all-reduce.1", "family": "all-reduce", "cls": "collective",
+         "t0": 100.6, "t1": 100.9},
+        {"name": "dot.9", "family": "dot", "cls": "compute",
+         "t0": 102.4, "t1": 102.6},  # outside every host span
+    ]
+    merged = merge_into_ring(tracer, ops)
+    assert merged == 3
+    spans = {s["name"]: s for s in tracer.snapshot()
+             if s["name"].startswith("device/")}
+    assert spans["device/compute/fusion"]["parent_id"] == fwd.span_id
+    assert spans["device/collective/all-reduce"]["parent_id"] == bwd.span_id
+    # the orphan hangs off the synthetic window root, not floating free
+    root = spans["device/window"]
+    assert spans["device/compute/dot"]["parent_id"] == root["span_id"]
+    assert spans["device/compute/fusion"]["attrs"]["hlo_op"] == "fusion.1"
+
+
+def test_merge_caps_op_count():
+    telemetry.configure(enabled=True, tracing=True)
+    tracer = TELEMETRY.tracer
+    ops = [{"name": f"dot.{i}", "family": "dot", "cls": "compute",
+            "t0": float(i), "t1": float(i) + 0.5} for i in range(50)]
+    merged = merge_into_ring(tracer, ops, max_ops=10)
+    assert merged == 10
+
+
+# ------------------------------------------------------- live capture (CPU)
+
+def test_live_capture_smoke(tmp_path):
+    telemetry.configure(enabled=True, tracing=True)
+    prof = DeviceProfiler(TELEMETRY, out_dir=str(tmp_path), keep=2)
+    assert prof.begin(tag="smoke")
+    try:
+        x = jnp.ones((64, 64), jnp.float32)
+        y = jax.jit(lambda a: a @ a)(x)
+        jax.block_until_ready(y)
+    finally:
+        res = prof.end(kind="train")
+    assert res is not None
+    summary = res["summary"]
+    assert summary["op_count"] > 0, "live CPU capture produced no device ops"
+    assert summary["class_seconds"]["compute"] > 0.0
+    assert 0.0 <= summary["overlap_fraction_measured"] <= 1.0
+    assert res["trace_path"] and os.path.exists(res["trace_path"])
+    # metrics exported, including the measured-source overlap gauge
+    reg = TELEMETRY.registry
+    assert reg.counter("devprof_captures_total").value(trigger="smoke") == 1
+    assert 0.0 <= reg.gauge("train_overlap_fraction").value(
+        source="measured") <= 1.0
+    assert reg.counter("devprof_ops_total").value(
+        **{"class": "compute"}) > 0
+    # the capture slot is released: a new window can start
+    assert prof.begin(tag="smoke2")
+    prof.abort()
+
+
+def test_single_concurrent_capture_guard(tmp_path):
+    prof_a = DeviceProfiler(out_dir=str(tmp_path / "a"))
+    prof_b = DeviceProfiler(out_dir=str(tmp_path / "b"))
+    assert prof_a.begin()
+    try:
+        # the guard is process-wide, not per-instance
+        assert not prof_b.begin()
+        assert not prof_a.begin()
+    finally:
+        prof_a.abort()
+    assert prof_b.begin()
+    prof_b.abort()
+
+
+def test_capture_dirs_rotate(tmp_path):
+    prof = DeviceProfiler(out_dir=str(tmp_path), keep=2)
+    for _ in range(4):
+        assert prof.begin()
+        jax.block_until_ready(jnp.zeros((8, 8)) + 1.0)
+        assert prof.end() is not None
+    caps = sorted(p for p in os.listdir(tmp_path) if p.startswith("cap-"))
+    assert len(caps) == 2, f"rotation kept {caps}"
+    assert caps == ["cap-000003", "cap-000004"]
+
+
+def test_load_trace_dir_missing():
+    assert load_trace_dir("/nonexistent/devprof") == (None, None)
+
+
+# --------------------------------------------------- engine windowed capture
+
+def _train_engine(tmp_path, interval=2):
+    reset_topology()
+    cfg = {
+        "train_micro_batch_size_per_device": 2,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 0,
+        "sequence_length": 16,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": 8},
+        "telemetry": {
+            "enabled": True,
+            "stepscope": {
+                "enabled": True,
+                "profile_interval_steps": interval,
+                "profile_dir": str(tmp_path),
+                "profile_keep": 2,
+            },
+        },
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lambda ctx: llama.build(llama.LlamaConfig.tiny(256), ctx=ctx),
+        config=cfg)
+    return engine
+
+
+def _batch(n=16, seq=16):
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, 256, (n, seq), dtype=np.int32)}
+
+
+def test_engine_windowed_capture_and_invariants(tmp_path):
+    engine = _train_engine(tmp_path, interval=2)
+    batch = _batch()
+    for _ in range(4):
+        engine.train_batch(batch)  # global_steps 0..3 -> capture at step 2
+    res = engine.devprof_last
+    assert res is not None, "interval trigger never completed a capture"
+    assert res["summary"]["op_count"] > 0
+    assert 0.0 <= res["summary"]["overlap_fraction_measured"] <= 1.0
+    assert res["summary"]["trigger"] == "stepscope"
+
+    # regression pin: a capture mid-run leaves stepscope's invariants
+    # intact — the profiled step is excluded from averages and the ±5%
+    # phase-sum pin still holds over the counted steps
+    s = engine.stepscope.summary()
+    assert s["steps"] == 3
+    assert s["profiled_steps"] == 1
+    assert s["phase_sum_over_step_ratio"] == pytest.approx(1.0, abs=0.05)
+    assert s["goodput_seconds"]["profiling"] > 0.0
+
+    # throughput exclusion: the compile-bearing first step AND the
+    # capture-bearing step are both out of the average
+    assert engine.tput_timer.excluded_count >= 2
+
+    # both overlap sources on the scrape
+    reg = TELEMETRY.registry
+    prom = reg.render_prometheus()
+    assert 'train_overlap_fraction{source="estimate"}' in prom
+    assert 'train_overlap_fraction{source="measured"}' in prom
+
+    # merged Perfetto export: device ops nest under host step/phase spans
+    events = TELEMETRY.dump_trace()["traceEvents"]
+    host_ids = {e["args"]["span_id"] for e in events
+                if e["name"] == "train/step"
+                or e["name"].startswith("train/phase/")}
+    device = [e for e in events if e["name"].startswith("device/")]
+    assert device, "no device spans merged into the trace ring"
+    nested = [e for e in device
+              if e["args"].get("parent_id") in host_ids]
+    assert nested, "device spans did not nest under host phase spans"
+    # profiled step is span-visible and flagged
+    flagged = [e for e in events if e["name"] == "train/step"
+               and e["args"].get("profiled")]
+    assert len(flagged) == 1
+
+
+def test_disabled_devprof_allocates_nothing(tmp_path):
+    engine = _train_engine(tmp_path, interval=0)  # stepscope on, devprof off
+    assert engine._devprof is None
+    batch = _batch()
+    engine.train_batch(batch)  # compile outside the pin
+    tracemalloc.start()
+    try:
+        for _ in range(3):
+            engine.train_batch(batch)
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, "*/telemetry/devprof.py")]).statistics(
+            "filename")
+    total = sum(s.size for s in stats)
+    assert total == 0, f"devprof allocated {total}B while disabled"
+
+
+# -------------------------------------------------- /debug/profile e2e
+
+CFG = llama.LlamaConfig(
+    vocab_size=97, hidden_size=32, intermediate_size=64,
+    num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+)
+
+
+@pytest.fixture
+def serving_stack():
+    from deepspeed_tpu.inference.ragged import (
+        RaggedConfig,
+        RaggedInferenceEngine,
+    )
+    from deepspeed_tpu.serving import (
+        EngineLoop,
+        ReplicaRouter,
+        RouterConfig,
+        ServingFrontend,
+    )
+
+    telemetry.configure(enabled=True, tracing=True)
+    eng = RaggedInferenceEngine(
+        lambda ctx: llama.build(CFG, ctx=ctx),
+        RaggedConfig(max_tokens_per_step=16, max_seqs=3, block_size=4,
+                     num_blocks=49, max_blocks_per_seq=16),
+        dtype=jnp.float32, seed=0)
+    loop = EngineLoop(eng, name="devprof-replica")
+    router = ReplicaRouter([loop], RouterConfig(max_queue_tokens=96))
+    frontend = ServingFrontend(router, port=0)
+    loop.start()
+    frontend.start()
+    yield frontend, loop
+    frontend.router.begin_drain()
+    loop.join(timeout=60)
+    frontend.close()
+
+
+def _get(frontend, path):
+    conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                      timeout=120)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    status = resp.status
+    conn.close()
+    return status, body
+
+
+def _post_completion(frontend, max_tokens=8):
+    conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                      timeout=120)
+    prompt = [int(t) for t in
+              np.random.default_rng(0).integers(0, CFG.vocab_size, 5)]
+    conn.request("POST", "/v1/completions",
+                 body=json.dumps({"prompt": prompt,
+                                  "max_tokens": max_tokens}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def test_debug_profile_e2e(serving_stack, tmp_path):
+    frontend, loop = serving_stack
+    results = {}
+
+    def _profile():
+        results["profile"] = _get(
+            frontend, "/debug/profile?steps=3&timeout_s=20")
+
+    t = threading.Thread(target=_profile)
+    t.start()
+    time.sleep(0.3)  # let the capture open before the work arrives
+    status, _ = _post_completion(frontend, max_tokens=8)
+    assert status == 200
+    t.join(timeout=60)
+    pstatus, pbody = results["profile"]
+    assert pstatus == 200, pbody
+    payload = json.loads(pbody)
+    assert payload["enabled"] is True
+    assert payload["requested_steps"] == 3
+    assert payload["observed_steps"] >= 3  # prefill + decode steps
+    assert payload["summary"]["op_count"] > 0
+    assert 0.0 <= payload["summary"]["overlap_fraction_measured"] <= 1.0
+    assert loop.steps >= payload["observed_steps"]
+
+
+def test_debug_profile_rejects_concurrent_capture(serving_stack, tmp_path):
+    frontend, _ = serving_stack
+    holder = DeviceProfiler(out_dir=str(tmp_path))
+    assert holder.begin()
+    try:
+        status, body = _get(frontend,
+                            "/debug/profile?steps=1&timeout_s=0.2")
+        assert status == 409
+        assert "in progress" in json.loads(body)["error"]["message"]
+    finally:
+        holder.abort()
+
+
+def test_debug_profile_rejects_bad_params(serving_stack):
+    frontend, _ = serving_stack
+    status, _ = _get(frontend, "/debug/profile?steps=abc")
+    assert status == 400
+
+
+def test_capture_serving_idle_window(tmp_path):
+    telemetry.configure(enabled=True, tracing=True)
+
+    class _IdleLoop:
+        steps = 0
+
+    res = capture_serving([_IdleLoop()], steps=2, max_wait_s=0.2,
+                          telemetry=TELEMETRY, out_dir=str(tmp_path))
+    assert res is not None
+    assert res["observed_steps"] == 0
+    assert res["summary"]["overlap_fraction_measured"] == 1.0
+
+
+def test_anchor_constant_stable():
+    # the parser looks the anchor up by name; keep them in lockstep
+    assert ANCHOR_NAME == "devprof/anchor"

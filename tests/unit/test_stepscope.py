@@ -100,7 +100,7 @@ def test_gauges_and_scrape():
     for _ in range(3):
         engine.train_batch(batch)
     reg = TELEMETRY.registry
-    overlap = reg.gauge("train_overlap_fraction").value()
+    overlap = reg.gauge("train_overlap_fraction").value(source="estimate")
     goodput = reg.gauge("train_goodput").value()
     assert 0.0 <= overlap <= 1.0
     assert 0.0 < goodput <= 1.0
