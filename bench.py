@@ -1663,9 +1663,25 @@ def train_chaos_worker_main():
             "state_dir": os.path.join(work_dir, "state"),
             "checkpoint_dir": ckpt_dir,
         }
+    mesh_devices = None
+    if e.get("CHAOS_PIPE"):
+        # staged-pipeline leg: 4 scanned layers split across 2 stage
+        # programs on one device, 4 microbatches per 1F1B round (the step
+        # pulls GAS loader items, so each step consumes 4 stream entries);
+        # the orchestrator SIGKILLs a stage thread mid-schedule via the
+        # pipe.stage fault point and expects exact stitched resume
+        import jax
+        model_cfg = llama.LlamaConfig(
+            vocab_size=vocab, hidden_size=32, intermediate_size=64,
+            num_layers=4, num_heads=4, num_kv_heads=2, max_seq_len=seq)
+        config["train_batch_size"] = batch * 4
+        config["gradient_accumulation_steps"] = 4
+        config["mesh"] = {"data": 1}
+        config["pipeline"] = {"stages": 2, "schedule": "1f1b"}
+        mesh_devices = jax.devices()[:1]
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=lambda ctx: llama.build(model_cfg, ctx=ctx), config=config,
-        training_data=loader, seed=5)
+        training_data=loader, seed=5, mesh_devices=mesh_devices)
 
     # arm the orchestrator's fault schedule BEFORE the resume: the
     # corrupt-at-load attempt models read-time bit-rot discovered during
@@ -1758,7 +1774,7 @@ def _train_chaos_impl():
     root = tempfile.mkdtemp(prefix="train_chaos_")
 
     def worker_env(work_dir, faults=None, sentinel=False, total=None,
-                   save_every=None):
+                   save_every=None, pipe=False):
         env = dict(os.environ)
         env.pop("BENCH_TRAIN_CHAOS", None)
         env.update(
@@ -1772,6 +1788,8 @@ def _train_chaos_impl():
         )
         if sentinel:
             env["CHAOS_SENTINEL"] = "1"
+        if pipe:
+            env["CHAOS_PIPE"] = "1"
         return env
 
     def read_jsonl(path):
@@ -2025,6 +2043,50 @@ def _train_chaos_impl():
                 and wedge_kills >= 1
                 and getattr(wedge_agent, "restarts", 0) >= 1)
 
+    # ---- phase 6: staged-pipeline leg — SIGKILL a stage thread mid-1F1B
+    # (pipe.stage fault point, request_id keyed to the stage-1 thread),
+    # restart, and the stitched trajectory must be step-identical to an
+    # uninterrupted 2-stage run (docs/PIPELINE.md "Failure semantics")
+    pipe_total, pipe_save = 6, 2
+    pipe_ref_dir = os.path.join(root, "pipe_ref")
+    pipe_ref_rc = run_worker(pipe_ref_dir, log_name="pipe_ref", pipe=True,
+                             total=pipe_total, save_every=pipe_save)
+    pipe_ref_traj = {r["step"]: r["loss"] for r in read_jsonl(
+        os.path.join(pipe_ref_dir, "trajectory.jsonl"))}
+
+    # stage 1 executes 2*M = 8 schedule instructions per step; after=19
+    # lands the kill inside the third step's 1F1B round, one step past the
+    # step-2 checkpoint, so the restart must resume and replay exactly
+    pipe_dir = os.path.join(root, "pipe")
+    pipe_runs = []
+    pipe_kill_rc = run_worker(
+        pipe_dir,
+        faults=[{"point": "pipe.stage", "kind": "kill",
+                 "request_id": "stage1", "after": 19}],
+        log_name="pipe_kill", pipe=True, total=pipe_total,
+        save_every=pipe_save)
+    pipe_runs.append({"label": "kill@pipe.stage", "rc": pipe_kill_rc})
+    extra = 0
+    while pipe_runs[-1]["rc"] != 0 and extra < 4:
+        extra += 1
+        rc = run_worker(pipe_dir, log_name=f"pipe_extra{extra}", pipe=True,
+                        total=pipe_total, save_every=pipe_save)
+        pipe_runs.append({"label": f"pipe_clean{extra}", "rc": rc})
+    pipe_traj: dict = {}
+    for r in read_jsonl(os.path.join(pipe_dir, "trajectory.jsonl")):
+        pipe_traj[r["step"]] = r["loss"]  # replayed steps: last write wins
+    pipe_max_rel = 0.0
+    for s in range(pipe_total):
+        a, b = pipe_traj.get(s), pipe_ref_traj.get(s)
+        if a is None or b is None:
+            pipe_max_rel = float("inf")
+            continue
+        pipe_max_rel = max(pipe_max_rel, abs(a - b) / max(1e-12, abs(b)))
+    pipe_killed = pipe_kill_rc is not None and pipe_kill_rc < 0
+    pipe_parity = (pipe_ref_rc == 0 and pipe_runs[-1]["rc"] == 0
+                   and set(pipe_traj) == set(range(pipe_total))
+                   and pipe_max_rel <= 1e-6)
+
     checks = {
         "completed": completed,
         "always_loadable": always_loadable,
@@ -2041,6 +2103,8 @@ def _train_chaos_impl():
         "sentinel_stitched_parity": sent_ref_rc == 0 and sent_parity,
         "sentinel_forensics": sent_forensics_ok,
         "wedge_heartbeat_kill": wedge_ok,
+        "pipe_stage_killed": pipe_killed,
+        "pipe_stitched_parity": pipe_parity,
     }
     ok = all(checks.values())
     if ok:
@@ -2070,13 +2134,125 @@ def _train_chaos_impl():
         "wedge_heartbeat_kills": wedge_kills,
         "wedge_agent_rc": wedge_rc,
         "wedge_agent_restarts": getattr(wedge_agent, "restarts", None),
+        "pipe_runs": pipe_runs,
+        "pipe_max_rel_loss_diff": pipe_max_rel,
         "backend": jax.default_backend(),
     }))
     return 0 if ok else 1
 
 
-def run_train_chaos_subprocess(timeout: float = 1050.0):
+def run_train_chaos_subprocess(timeout: float = 1350.0):
     return _run_flagged_subprocess("BENCH_TRAIN_CHAOS", timeout)
+
+
+def pipeline_bench_main():
+    """Child process: staged-pipeline trial (runtime/pipe/, docs/PIPELINE.md).
+
+    Trains the same tiny llama twice — single fused program, then a 2-stage
+    1F1B pipeline over the identical deterministic batch stream — and
+    reports the parity verdict (the staged run must reproduce the fused
+    loss trajectory to <=1e-6 rel; on CPU it is bit-exact), the measured
+    bubble fraction from stepscope's ``train_pipe_bubble_fraction`` gauge
+    next to the schedule's analytic value, and the per-stage wall
+    breakdown (busy seconds per stage thread vs schedule wall)."""
+    import numpy as np
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.runtime.pipe.schedule import bubble_fraction
+    from deepspeed_tpu.telemetry import TELEMETRY
+
+    e = os.environ
+    steps = int(e.get("BENCH_PIPELINE_STEPS", 8))
+    stages = int(e.get("BENCH_PIPELINE_STAGES", 2))
+    gas = int(e.get("BENCH_PIPELINE_GAS", 4))
+    sched = e.get("BENCH_PIPELINE_SCHEDULE", "1f1b")
+    n_layers, vocab, seq = 2 * stages, 97, 32
+
+    model_cfg = llama.LlamaConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128,
+        num_layers=n_layers, num_heads=4, num_kv_heads=2, max_seq_len=seq)
+
+    def batches():
+        rng = np.random.default_rng(42)
+        return [{"input_ids": rng.integers(0, vocab, (8, seq),
+                                           dtype=np.int32)}
+                for _ in range(steps)]
+
+    def config(pipeline):
+        cfg = {
+            "train_micro_batch_size_per_device": 8 // gas,
+            "gradient_accumulation_steps": gas,
+            "steps_per_print": 0,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "mesh": {"data": 1},
+            "fp16": {"enabled": True, "initial_scale_power": 8},
+            "gradient_clipping": 1.0,
+            "seed": 7,
+        }
+        if pipeline:
+            cfg["pipeline"] = {"stages": stages, "schedule": sched}
+            cfg["telemetry"] = {"enabled": True,
+                                "stepscope": {"enabled": True}}
+        return cfg
+
+    def run(pipeline):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=lambda ctx: llama.build(model_cfg, ctx=ctx),
+            config=config(pipeline), seed=11,
+            mesh_devices=jax.devices()[:1])
+        losses = [float(engine.train_batch(b)) for b in batches()]
+        return engine, losses
+
+    _, base = run(False)
+    pipe_engine, pipe = run(True)
+
+    max_rel = max(abs(a - b) / max(1e-12, abs(a))
+                  for a, b in zip(base, pipe))
+    parity_ok = max_rel <= 1e-6
+
+    busy = list(pipe_engine._last_stage_busy)
+    wall = pipe_engine._last_stage_wall
+    measured_bubble = pipe_engine.stepscope._g_pipe_bubble.value()
+    plan = pipe_engine.stage_plan
+    analytic_bubble = bubble_fraction(sched, plan.n_virtual, gas)
+    prom = TELEMETRY.registry.render_prometheus()
+
+    checks = {
+        "loss_parity": parity_ok,
+        "bubble_gauge_nonzero": measured_bubble > 0.0,
+        "stage_breakdown": len(busy) == stages and wall > 0.0,
+        "scrape_has_pipe_bubble": "train_pipe_bubble_fraction" in prom,
+        "scrape_has_stage_skew":
+            'train_step_skew_ratio{stage="0"}' in prom,
+    }
+    ok = all(checks.values())
+    pipe_engine.destroy()
+    print(json.dumps({
+        "metric": "pipeline",
+        "pipeline_ok": ok,
+        "error": None if ok else {
+            "reason": "pipeline assertions failed",
+            "failed": sorted(k for k, v in checks.items() if not v)},
+        "pipeline_checks": checks,
+        "stages": stages,
+        "schedule": sched,
+        "n_microbatches": gas,
+        "steps": steps,
+        "max_rel_loss_diff": max_rel,
+        "bubble_fraction_measured": measured_bubble,
+        "bubble_fraction_analytic": analytic_bubble,
+        "stage_busy_s": [round(b, 4) for b in busy],
+        "schedule_wall_s": round(wall, 4),
+        "stage_restarts": pipe_engine.stage_restarts,
+        "backend": jax.default_backend(),
+    }))
+    return 0 if ok else 1
+
+
+def run_pipeline_subprocess(timeout: float = 900.0):
+    return _run_flagged_subprocess("BENCH_PIPELINE", timeout)
 
 
 def probe_device():
@@ -2399,10 +2575,19 @@ def main():
                 return 1
             print(json.dumps(result))
             return 0 if result.get("train_chaos_ok") else 1
+        if mode == ["pipeline"]:
+            result, err = run_pipeline_subprocess()
+            if result is None:
+                print(f"pipeline bench failed:\n{_err_text(err)}",
+                      file=sys.stderr)
+                _fail_json(err)
+                return 1
+            print(json.dumps(result))
+            return 0 if result.get("pipeline_ok") else 1
         if mode != ["serving"]:
             print(f"bench: unknown --mode {mode or '(missing)'}; "
                   "supported: serving, decode-steady, chaos, train-anatomy, "
-                  "train-chaos",
+                  "train-chaos, pipeline",
                   file=sys.stderr)
             return 2
         if "--disagg" in sys.argv:
@@ -2449,6 +2634,10 @@ def main():
         # no jit cache: the chaos child runs a deliberately tiny model and
         # must not pollute the shared compile cache with fault-path programs
         return chaos_bench_main()
+    if os.environ.get("BENCH_PIPELINE"):
+        # no jit cache: per-stage programs are tiny and the parity verdict
+        # must not hinge on a cache-deserialized fused baseline
+        return pipeline_bench_main()
     if os.environ.get("BENCH_SERVING_DISAGG"):
         _enable_jit_cache()
         return disagg_bench_main()

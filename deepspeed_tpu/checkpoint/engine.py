@@ -320,7 +320,26 @@ def verify_checkpoint(ckpt_dir: str, deep: bool = True) -> dict:
                     f"{tag}: {fn} sha256 mismatch (on-disk corruption)",
                     stage="checksum-mismatch", tag=tag)
     _verify_indexes(ckpt_dir, tag)
+    _verify_pipeline_fragments(ckpt_dir, tag, manifest)
     return manifest
+
+
+def _verify_pipeline_fragments(ckpt_dir: str, tag: str, manifest: dict) -> None:
+    """A staged-pipeline checkpoint's manifest records which per-stage
+    fragment files it expects (``manifest["pipeline"]["fragments"]``); the
+    generic file table would also catch a missing one, but cross-checking
+    here names the STAGE that lost its shard instead of just the file."""
+    pipe = manifest.get("pipeline")
+    if not isinstance(pipe, dict):
+        return
+    for stage, names in (pipe.get("fragments") or {}).items():
+        for fn in names:
+            if not os.path.exists(os.path.join(ckpt_dir, fn)):
+                raise CheckpointCorruptError(
+                    f"{tag}: pipeline stage {stage} fragment {fn} is "
+                    "missing (manifest declares "
+                    f"{pipe.get('stages')} stages)",
+                    stage="pipeline-fragments", tag=tag)
 
 
 # ------------------------------------------------------------------ tag ladder

@@ -66,22 +66,44 @@ def is_sharded(ckpt_dir: str, name: str) -> bool:
     return os.path.exists(os.path.join(ckpt_dir, f"{name}.index.json"))
 
 
-def collect_fragments(tree: Any, name: str) -> tuple[dict, dict]:
+def _shift_box(box: list[list[int]], offset: int) -> list[list[int]]:
+    """Shift a normalized box's dim-0 range by ``offset`` (into the global
+    coordinate frame a pipeline-stage fragment lives in)."""
+    if not box:
+        return box
+    (s, e), rest = box[0], box[1:]
+    return [[s + offset, e + offset]] + rest
+
+
+def collect_fragments(tree: Any, name: str, part: str = "",
+                      boxes: dict | None = None) -> tuple[dict, dict]:
     """Snapshot this process's unique shards of ``tree`` to host numpy.
 
     Returns ``(payload, index)``. The host copies ARE the double buffer of an
     async save: once collected, the device arrays may keep training while a
     writer thread flushes the payload (reference ``deepspeed/io``
-    double-buffered writers / ``decoupled_checkpoint_engine``)."""
+    double-buffered writers / ``decoupled_checkpoint_engine``).
+
+    ``part`` suffixes the fragment file name (``{name}_shard_p{proc}{part}``)
+    so several collects of the same tree name — the MPMD pipeline's per-stage
+    saves — coexist in one checkpoint. ``boxes`` maps a leaf key to
+    ``(dim0_offset, global_shape)``: the leaf is recorded at its GLOBAL
+    coordinates (index shape = global shape, fragment boxes shifted by the
+    offset), which is how a layer-range slice advertises where it sits in the
+    full stacked tree — any-S restores then reduce to ordinary
+    fragment-overlap pasting."""
     proc = jax.process_index()
+    boxes = boxes or {}
     payload: dict[str, np.ndarray] = {}
     index: dict[str, Any] = {}
+    fname = f"{name}_shard_p{proc}{part}.npz"
     peak = 0
 
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = _leaf_key(path)
         arr = leaf if isinstance(leaf, jax.Array) else np.asarray(leaf)
         shape = tuple(arr.shape)
+        offset, global_shape = boxes.get(key, (0, shape))
         frags = []
         if isinstance(arr, jax.Array) and arr.sharding is not None:
             shards = [s for s in arr.addressable_shards if s.replica_id == 0]
@@ -91,9 +113,10 @@ def collect_fragments(tree: Any, name: str) -> tuple[dict, dict]:
                 member = _member(key, len(frags))
                 payload[member] = data
                 frags.append({
-                    "file": f"{name}_shard_p{proc}.npz",
+                    "file": fname,
                     "member": member,
-                    "index": _norm_index(shard.index, shape),
+                    "index": _shift_box(
+                        _norm_index(shard.index, shape), offset),
                 })
         else:
             data = np.asarray(arr)
@@ -101,12 +124,12 @@ def collect_fragments(tree: Any, name: str) -> tuple[dict, dict]:
             member = _member(key, 0)
             payload[member] = data
             frags.append({
-                "file": f"{name}_shard_p{proc}.npz",
+                "file": fname,
                 "member": member,
-                "index": [[0, d] for d in shape],
+                "index": _shift_box([[0, d] for d in shape], offset),
             })
         index[key] = {
-            "shape": list(shape),
+            "shape": list(global_shape),
             "dtype": str(np.dtype(arr.dtype)),
             "fragments": frags,
         }
@@ -115,20 +138,25 @@ def collect_fragments(tree: Any, name: str) -> tuple[dict, dict]:
     return payload, index
 
 
-def write_fragments(ckpt_dir: str, name: str, payload: dict, index: dict) -> None:
+def write_fragments(ckpt_dir: str, name: str, payload: dict, index: dict,
+                    part: str = "") -> None:
     """Flush a collected payload + index to disk (sync; callers may run it on
-    a writer thread)."""
+    a writer thread). A ``part`` suffix always writes a PARTIAL index (even
+    single-process): several parts of one tree name merge in
+    ``finalize_index`` exactly like multi-host partials."""
     os.makedirs(ckpt_dir, exist_ok=True)
     proc = jax.process_index()
-    np.savez(os.path.join(ckpt_dir, f"{name}_shard_p{proc}.npz"), **payload)
-    if jax.process_count() == 1:
+    np.savez(os.path.join(ckpt_dir, f"{name}_shard_p{proc}{part}.npz"),
+             **payload)
+    if jax.process_count() == 1 and not part:
         with open(os.path.join(ckpt_dir, f"{name}.index.json"), "w") as f:
             json.dump(index, f)
     else:
-        # multi-host: fragment lists are per-process; each process writes a
-        # tiny partial index, and process 0 merges them in finalize_index()
+        # multi-host (or multi-part): fragment lists are per-process/part;
+        # each writes a tiny partial index, merged in finalize_index()
         # AFTER the caller's barrier (so no partial file is read early)
-        with open(os.path.join(ckpt_dir, f"{name}.index.p{proc}.json"), "w") as f:
+        with open(os.path.join(
+                ckpt_dir, f"{name}.index.p{proc}{part}.json"), "w") as f:
             json.dump(index, f)
 
 
@@ -215,14 +243,23 @@ def assemble_full(ckpt_dir: str, name: str) -> dict[str, np.ndarray]:
     return out
 
 
-def load_sharded(template: Any, ckpt_dir: str, name: str, strict: bool = True) -> Any:
+def load_sharded(template: Any, ckpt_dir: str, name: str, strict: bool = True,
+                 boxes: dict | None = None) -> Any:
     """Rebuild a tree congruent to ``template`` (jax Arrays carrying the
     *target* shardings) from a sharded checkpoint, assembling only the shards
     this process's devices own. Dtype follows the template (bf16 deployments
-    can load fp32 masters)."""
+    can load fp32 masters).
+
+    ``boxes`` maps a leaf key to ``(dim0_offset, global_shape)``: the
+    template leaf is a dim-0 window of the checkpointed global leaf (a
+    pipeline stage's layer range) and its shards paste from whatever
+    fragments overlap that window — so a stage restores from a same-S save
+    (its own fragment, exact) or a different-S / single-program save
+    (sliced) through the one code path."""
     with open(os.path.join(ckpt_dir, f"{name}.index.json")) as f:
         index = json.load(f)
     reader = _FragmentReader(ckpt_dir)
+    boxes = boxes or {}
     peak = 0
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(template)
@@ -237,17 +274,19 @@ def load_sharded(template: Any, ckpt_dir: str, name: str, strict: bool = True) -
                 leaves.append(leaf)
                 continue
             shape = tuple(meta["shape"])
-            if tuple(np.shape(leaf)) != shape:
+            offset, global_shape = boxes.get(key, (0, tuple(np.shape(leaf))))
+            if shape != tuple(global_shape):
                 raise ValueError(
                     f"checkpoint leaf {key} shape {shape} != expected "
-                    f"{tuple(np.shape(leaf))}"
+                    f"{tuple(global_shape)}"
                 )
+            local_shape = tuple(np.shape(leaf))
             dtype = np.dtype(leaf.dtype) if hasattr(leaf, "dtype") else np.dtype(
                 meta["dtype"])
 
             if isinstance(leaf, jax.Array):
                 sharding = leaf.sharding
-                dev_map = sharding.addressable_devices_indices_map(shape)
+                dev_map = sharding.addressable_devices_indices_map(local_shape)
                 # assemble each UNIQUE shard box once; replicas reuse the
                 # same host buffer (a replicated leaf reads its fragments
                 # once, not once per device)
@@ -255,14 +294,23 @@ def load_sharded(template: Any, ckpt_dir: str, name: str, strict: bool = True) -
                 singles = []
                 for dev, idx in dev_map.items():
                     dst_idx = _norm_index(
-                        tuple(idx) + (slice(None),) * (len(shape) - len(idx)),
-                        shape,
-                    ) if idx is not None else [[0, d] for d in shape]
+                        tuple(idx) + (slice(None),) * (len(local_shape)
+                                                       - len(idx)),
+                        local_shape,
+                    ) if idx is not None else [[0, d] for d in local_shape]
+                    # shards address the LOCAL window; fragments live at
+                    # global coordinates — shift the destination box up
+                    dst_idx = _shift_box(dst_idx, offset)
                     box = tuple(tuple(b) for b in dst_idx)
                     buf = assembled.get(box)
                     if buf is None:
                         buf = np.zeros([e - s for s, e in dst_idx], dtype)
-                        filled = 0
+                        # coverage by mask, not by summed volumes: fragments
+                        # may legitimately overlap (per-stage pipeline saves
+                        # duplicate unsliced leaves like the adam step count;
+                        # cross-S restores paste partial windows) — what must
+                        # hold is that the UNION covers every cell
+                        mask = np.zeros(buf.shape, bool)
                         for frag in meta["fragments"]:
                             ov = _overlap(dst_idx, frag["index"])
                             if ov is None:
@@ -270,21 +318,24 @@ def load_sharded(template: Any, ckpt_dir: str, name: str, strict: bool = True) -
                             data = reader.get(frag)
                             peak = max(peak, buf.nbytes + data.nbytes)
                             buf[ov[0]] = data[ov[1]].astype(dtype)
-                            filled += int(np.prod([s.stop - s.start for s in ov[0]]))
-                        if filled != buf.size:
+                            mask[ov[0]] = True
+                        if not mask.all():
                             raise ValueError(
-                                f"checkpoint fragments cover {filled}/{buf.size} "
+                                f"checkpoint fragments cover "
+                                f"{int(mask.sum())}/{buf.size} "
                                 f"elements of {key} shard"
                             )
                         assembled[box] = buf
                     singles.append(jax.device_put(buf, dev))
                 leaves.append(jax.make_array_from_single_device_arrays(
-                    shape, sharding, singles))
+                    local_shape, sharding, singles))
             else:
-                # host template leaf: assemble the full array
-                buf = np.zeros(shape, dtype)
+                # host template leaf: assemble the full (local) array
+                buf = np.zeros(local_shape, dtype)
                 for frag in meta["fragments"]:
-                    ov = _overlap([[0, d] for d in shape], frag["index"])
+                    ov = _overlap(
+                        _shift_box([[0, d] for d in local_shape], offset),
+                        frag["index"])
                     if ov is None:
                         continue
                     data = reader.get(frag)

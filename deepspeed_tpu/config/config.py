@@ -418,7 +418,18 @@ class SequenceParallelConfig(ConfigBase):
 
 @dataclass
 class PipelineConfig(ConfigBase):
-    """Pipeline schedule config (reference: ``runtime/pipe/``)."""
+    """Pipeline schedule config (reference: ``runtime/pipe/``).
+
+    Two distinct runtimes share this block:
+
+    - the in-jit SPMD pipelines (``parallel/pipeline.py`` /
+      ``parallel/pipeline_1f1b.py``), enabled by a ``pipeline`` axis in the
+      mesh — one XLA program, ppermute between stages;
+    - the MPMD staged runtime (``runtime/pipe/``), enabled by ``stages > 1``
+      — S separately-dispatched stage programs with activation send/recv
+      over a transport, per-stage params + optimizer shards, crash-safe
+      per-stage checkpoints.
+    """
 
     num_microbatches: int = 0  # 0 => use gradient_accumulation_steps
     partition_method: str = "uniform"  # uniform | parameters
@@ -427,10 +438,32 @@ class PipelineConfig(ConfigBase):
     # 1f1b:  interleaved schedule, P-deep stash, composes with fsdp
     #        (reference schedule.py:189 TrainSchedule)
     schedule: str = "gpipe"
+    # MPMD staged runtime (runtime/pipe/): number of stage programs.
+    # 0/1 = off (single-program engine); >1 routes deepspeed_tpu.initialize()
+    # to the staged PipeEngine.
+    stages: int = 0
+    # virtual chunks per stage (interleaved 1F1B when > 1): stage s owns
+    # chunks s, s+S, s+2S, ... of the layer range
+    interleave: int = 1
+    # activation/grad transport between stage programs: inproc = in-process
+    # queues (one thread per stage, CPU-testable); device = reserved for
+    # jax.device_put / collective-permute transports
+    transport: str = "inproc"
 
     def _validate(self, path: str = "") -> None:
         if self.schedule not in ("gpipe", "1f1b"):
             raise ConfigError(f"{path}schedule: must be gpipe|1f1b")
+        if self.stages < 0:
+            raise ConfigError(f"{path}stages: must be >= 0, got {self.stages}")
+        if self.interleave < 1:
+            raise ConfigError(
+                f"{path}interleave: must be >= 1, got {self.interleave}")
+        if self.interleave > 1 and self.schedule != "1f1b":
+            raise ConfigError(
+                f"{path}interleave: interleaved chunks require "
+                f"schedule='1f1b' (got {self.schedule!r})")
+        if self.transport not in ("inproc", "device"):
+            raise ConfigError(f"{path}transport: must be inproc|device")
 
 
 @dataclass

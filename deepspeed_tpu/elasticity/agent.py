@@ -20,6 +20,7 @@ signal path (SIGTERM → stop admission → finish inflight → exit).
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import signal
@@ -142,12 +143,24 @@ class ElasticAgent:
         for rank, w in enumerate(self.workers):
             if w.proc.poll() is not None:
                 continue
-            path = os.path.join(self.heartbeat_dir, f"heartbeat_{rank}.json")
-            try:
-                age = wall - os.path.getmtime(path)
-            except OSError:
-                # no beacon yet: only the grace window applies
-                age = None
+            # the rank beacon plus any per-pipeline-stage beacons
+            # (heartbeat_{rank}_s{t}.json, one per MPMD stage thread): the
+            # staleness verdict is the WORST of them, so a single wedged
+            # stage flags the worker even while the step-boundary rank
+            # beacon keeps beating
+            paths = [os.path.join(self.heartbeat_dir,
+                                  f"heartbeat_{rank}.json")]
+            paths.extend(sorted(glob.glob(os.path.join(
+                self.heartbeat_dir, f"heartbeat_{rank}_s*.json"))))
+            ages = []
+            for path in paths:
+                try:
+                    ages.append(wall - os.path.getmtime(path))
+                except OSError:
+                    # no beacon yet: only the grace window applies
+                    ages.append(None)
+            age = (None if any(a is None for a in ages)
+                   else max(ages))
             in_grace = now - self._launch_time < max(
                 self.heartbeat_grace, self.heartbeat_timeout)
             if in_grace:

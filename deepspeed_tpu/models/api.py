@@ -314,6 +314,11 @@ class ModelSpec:
     # 1F1B pipeline decomposition (parallel/pipeline_1f1b.py): the tuple
     # (stage0_fn, block_fn, last_fn, split_fn, merge_fn) itself
     pipeline_parts: Any = None
+    # MPMD staged runtime (runtime/pipe/): which non-"layers" param key each
+    # stage program owns — maps extras key -> "first" | "last". None means
+    # the model cannot be staged (e.g. tied embeddings: the shared table
+    # would need a cross-stage grad reduction the transport doesn't carry).
+    pipeline_extras_owner: dict | None = None
     # whether loss_fn honors batch["pld_theta"] (progressive layer drop);
     # the engine refuses to enable PLD on models that would silently ignore it
     supports_pld: bool = False

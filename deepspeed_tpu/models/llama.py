@@ -439,6 +439,12 @@ def build(cfg: LlamaConfig, ctx: ShardCtx | None = None, attn_impl: str = "auto"
         ragged_forward_fn=partial(ragged_forward, cfg),
         supports_prefill_tiles=True,
         pipeline_parts=pipeline_parts(cfg, ctx=ctx, attn_impl=attn_impl),
+        # MPMD staging: untied models split cleanly (embed grads live on the
+        # first stage, head grads on the last); a tied table would need its
+        # gradient reduced across both end stages, which the activation
+        # transport does not carry — None tells PipeEngine to refuse.
+        pipeline_extras_owner=(None if cfg.tie_embeddings else {
+            "embed": "first", "final_norm": "last", "lm_head": "last"}),
         supports_pld=True,
         supports_random_ltd=True,
     )

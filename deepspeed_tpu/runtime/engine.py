@@ -92,6 +92,12 @@ class Engine:
         seed: int | None = None,
         initial_params: Any = None,
     ):
+        if (config.pipeline.stages > 1
+                and not getattr(self, "_supports_staged_pipeline", False)):
+            raise ValueError(
+                "pipeline.stages > 1 selects the staged MPMD runtime; "
+                "construct it through initialize() (which routes to "
+                "runtime.pipe.engine.PipeEngine) instead of Engine directly")
         self.config = config
         self.topo = topo
         sp_cfg = config.sequence_parallel
@@ -2464,6 +2470,61 @@ class Engine:
             self._train_rng = jnp.asarray(
                 np.asarray(state["_train_rng"], np.uint32))
 
+    def _manifest_extra(self) -> dict:
+        """Extra manifest rows contributed by engine subclasses (the staged
+        pipeline records its partition + fragment layout here)."""
+        return {}
+
+    def _collect_ckpt_payloads(self, stage_dir: str) -> list:
+        """Host-snapshot every sharded payload this engine persists.
+
+        Returns ``[(name, part, (payload, index)), ...]`` where ``part`` is
+        the fragment-file suffix (empty for the single-program engine,
+        ``_s{v}`` per virtual stage for the pipeline). ``flush`` writes each
+        as ``{name}_shard_p{proc}{part}.npz`` and finalizes one index per
+        unique ``name``."""
+        import os
+
+        from deepspeed_tpu.checkpoint import sharded
+
+        payloads = [("model", "",
+                     sharded.collect_fragments(self.params, "model"))]
+        if self._offload_mode == "nvme":
+            # state lives on disk between steps; stream it GROUP BY GROUP into
+            # per-group fragment files so host RAM never holds the full
+            # optimizer state (a [None]*g placeholder list reproduces the
+            # grouped-save key layout; the index's per-fragment file names
+            # point the loader at the right group file)
+            import jax as _jax
+
+            os.makedirs(stage_dir, exist_ok=True)
+            index: dict = {}
+            for g, t in enumerate(self._nvme_templates):
+                state = self._swapper.swap_in_tree(f"opt_g{g}", t)
+                p, ix = sharded.collect_fragments(
+                    [None] * g + [state], f"optimizer_g{g}")
+                np.savez(os.path.join(
+                    stage_dir,
+                    f"optimizer_g{g}_shard_p{_jax.process_index()}.npz"), **p)
+                index.update(ix)
+                del state, p
+            payloads.append(("optimizer", "", ({}, index)))
+        else:
+            payloads.append(("optimizer", "", sharded.collect_fragments(
+                self.opt_state, "optimizer")))
+        return payloads
+
+    def _restore_sharded_model(self, ckpt_dir: str) -> None:
+        from deepspeed_tpu.checkpoint import sharded
+
+        self.params = sharded.load_sharded(self.params, ckpt_dir, "model")
+
+    def _restore_sharded_optimizer(self, ckpt_dir: str) -> None:
+        from deepspeed_tpu.checkpoint import sharded
+
+        self.opt_state = sharded.load_sharded(
+            self.opt_state, ckpt_dir, "optimizer")
+
     def save_checkpoint(self, save_dir: str, tag: str | None = None,
                         client_state: dict | None = None, save_latest: bool = True):
         """Reference ``engine.py:4557 save_checkpoint``: tagged dir + manifest +
@@ -2513,31 +2574,10 @@ class Engine:
             "config": self.config.to_dict(),
             "client_state": client_state or {},
         }
+        manifest.update(self._manifest_extra())
         # snapshot to host now (double buffer); flush sync or on writer thread
         inj.fire(_faults.POINT_CKPT_COLLECT)
-        model_payload = sharded.collect_fragments(self.params, "model")
-        if self._offload_mode == "nvme":
-            # state lives on disk between steps; stream it GROUP BY GROUP into
-            # per-group fragment files so host RAM never holds the full
-            # optimizer state (a [None]*g placeholder list reproduces the
-            # grouped-save key layout; the index's per-fragment file names
-            # point the loader at the right group file)
-            import jax as _jax
-
-            os.makedirs(stage_dir, exist_ok=True)
-            index: dict = {}
-            for g, t in enumerate(self._nvme_templates):
-                state = self._swapper.swap_in_tree(f"opt_g{g}", t)
-                p, ix = sharded.collect_fragments(
-                    [None] * g + [state], f"optimizer_g{g}")
-                np.savez(os.path.join(
-                    stage_dir,
-                    f"optimizer_g{g}_shard_p{_jax.process_index()}.npz"), **p)
-                index.update(ix)
-                del state, p
-            opt_payload = ({}, index)
-        else:
-            opt_payload = sharded.collect_fragments(self.opt_state, "optimizer")
+        payloads = self._collect_ckpt_payloads(stage_dir)
 
         # the host double buffer is real memory for the collect→flush window:
         # attribute it to the ledger so an OOM during an async save shows the
@@ -2549,7 +2589,7 @@ class Engine:
 
             stage_handle = led.register(
                 "staging_buffers", f"ckpt/{tag}/host_snapshot",
-                tree_nbytes(model_payload[0]) + tree_nbytes(opt_payload[0]))
+                sum(tree_nbytes(p[0]) for _, _, p in payloads))
 
         def flush():
             import jax as _jax
@@ -2557,16 +2597,16 @@ class Engine:
             try:
                 # phase 1 (prepare): everything goes into the staging dir
                 inj.fire(_faults.POINT_CKPT_FLUSH)
-                sharded.write_fragments(stage_dir, "model", *model_payload)
-                inj.fire(_faults.POINT_CKPT_FLUSH, path=os.path.join(
-                    stage_dir, f"model_shard_p{_jax.process_index()}.npz"))
-                sharded.write_fragments(stage_dir, "optimizer", *opt_payload)
-                inj.fire(_faults.POINT_CKPT_FLUSH, path=os.path.join(
-                    stage_dir, f"optimizer_shard_p{_jax.process_index()}.npz"))
+                for name, part, payload in payloads:
+                    sharded.write_fragments(stage_dir, name, *payload,
+                                            part=part)
+                    inj.fire(_faults.POINT_CKPT_FLUSH, path=os.path.join(
+                        stage_dir,
+                        f"{name}_shard_p{_jax.process_index()}{part}.npz"))
                 dist.barrier("save_checkpoint")
                 if _jax.process_index() == 0:
-                    sharded.finalize_index(stage_dir, "model")
-                    sharded.finalize_index(stage_dir, "optimizer")
+                    for name in dict.fromkeys(n for n, _, _ in payloads):
+                        sharded.finalize_index(stage_dir, name)
                     # phase 2 (commit): checksum + manifest + atomic promote
                     ckpt_dir = ckpt.commit_checkpoint(
                         save_dir, str(tag), manifest)
@@ -2739,7 +2779,7 @@ class Engine:
 
         if sharded.is_sharded(ckpt_dir, "model"):
             # assemble only this process's target shards from the fragments
-            self.params = sharded.load_sharded(self.params, ckpt_dir, "model")
+            self._restore_sharded_model(ckpt_dir)
             if load_optimizer_states and sharded.is_sharded(ckpt_dir, "optimizer"):
                 try:
                     if self._offload_mode == "nvme":
@@ -2753,8 +2793,7 @@ class Engine:
                                 self._swapper.swap_out_tree(f"opt_g{g}", state))
                         self._swapper.commit()
                     else:
-                        self.opt_state = sharded.load_sharded(
-                            self.opt_state, ckpt_dir, "optimizer")
+                        self._restore_sharded_optimizer(ckpt_dir)
                 except KeyError as e:
                     raise ValueError(
                         "optimizer checkpoint layout does not match this "
@@ -2952,6 +2991,14 @@ def initialize(
         topo = dist.init_distributed(cfg.mesh, devices=mesh_devices)
     cfg.resolve_batch_sizes(topo.dp_world_size)
     dist.configure(cfg.comms_logger)
-    engine = Engine(model, cfg, topo, training_data=training_data, seed=seed,
-                    initial_params=initial_params)
+    if cfg.pipeline.stages > 1:
+        # the staged MPMD runtime: per-stage programs + schedule executor
+        # (stages in (0, 1) keep the single fused program — bit-identical)
+        from deepspeed_tpu.runtime.pipe.engine import PipeEngine
+
+        engine = PipeEngine(model, cfg, topo, training_data=training_data,
+                            seed=seed, initial_params=initial_params)
+    else:
+        engine = Engine(model, cfg, topo, training_data=training_data,
+                        seed=seed, initial_params=initial_params)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler

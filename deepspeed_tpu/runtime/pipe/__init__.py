@@ -1,0 +1,25 @@
+"""MPMD pipeline parallelism (staged training).
+
+Unlike the in-jit SPMD pipelines (``parallel/pipeline.py`` /
+``parallel/pipeline_1f1b.py``), which compile ONE program with a
+``pipeline`` mesh axis and ppermute between stage shards, this package runs
+S separately-dispatched stage programs (the MPMD execution model of
+arxiv 2412.14374): each stage owns a contiguous slice of the scanned layer
+stack plus its end extras (embedding / final-norm+head), its own optimizer
+shard, and a thread that walks a deterministic 1F1B/GPipe instruction list,
+exchanging activations and activation-grads over a transport seam.
+
+- :mod:`.partition` — layer-range planning + param pytree split/merge
+- :mod:`.schedule` — closed-form GPipe / 1F1B / interleaved instruction lists
+- :mod:`.transport` — send/recv seam (in-process queues today; shaped for
+  ``jax.device_put`` / collective-permute later)
+- :mod:`.engine` — :class:`PipeEngine`, the staged drop-in for
+  :class:`~deepspeed_tpu.runtime.engine.Engine`
+"""
+
+from deepspeed_tpu.runtime.pipe.partition import (  # noqa: F401
+    StagePlan, plan_stages, split_params, merge_params, stage_boxes)
+from deepspeed_tpu.runtime.pipe.schedule import (  # noqa: F401
+    Instr, build_schedule, bubble_fraction, validate_schedule)
+from deepspeed_tpu.runtime.pipe.transport import (  # noqa: F401
+    Transport, InProcTransport, TransportAborted)

@@ -1,0 +1,168 @@
+"""Stage partitioner: split the scanned layer stack into S contiguous
+stage programs.
+
+The models built against :class:`~deepspeed_tpu.models.api.ShardCtx` keep
+every decoder layer stacked on dim 0 of each leaf under ``params["layers"]``
+(the ``lax.scan`` layout), so a stage's parameters are literally
+``leaf[lo:hi]`` slices plus whichever non-layer extras the stage owns
+(embedding on the first virtual stage, final-norm + head on the last —
+reference ``PipelineModule`` partitioning, ``module.py:396 _partition_layers``).
+
+Stage trees are SUBSET dicts of the full param tree (same nesting, missing
+keys dropped), so ``jax.tree_util.keystr`` paths — the checkpoint fragment
+keys — coincide with the single-program engine's keys and a merged restore
+falls out of the ordinary fragment-overlap loader.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+
+@dataclass(frozen=True)
+class StagePlan:
+    """Contiguous layer ranges for ``n_stages * interleave`` virtual stages.
+
+    ``boundaries[v] : boundaries[v+1]`` is virtual stage v's layer slice;
+    virtual stage v executes on thread ``v % n_stages`` (interleaved 1F1B
+    assigns each thread every S-th chunk).
+    """
+
+    n_layers: int
+    n_stages: int
+    interleave: int
+    boundaries: tuple  # len n_virtual + 1, ascending, [0 .. n_layers]
+
+    @property
+    def n_virtual(self) -> int:
+        return self.n_stages * self.interleave
+
+    def layer_range(self, v: int) -> tuple:
+        return self.boundaries[v], self.boundaries[v + 1]
+
+    def thread_of(self, v: int) -> int:
+        return v % self.n_stages
+
+    def chunks_of(self, thread: int) -> list:
+        return list(range(thread, self.n_virtual, self.n_stages))
+
+    def describe(self) -> str:
+        ranges = ", ".join(
+            f"s{v}:[{self.boundaries[v]}:{self.boundaries[v + 1]})"
+            for v in range(self.n_virtual))
+        return (f"{self.n_stages} stages x {self.interleave} chunk(s) over "
+                f"{self.n_layers} layers ({ranges})")
+
+
+def plan_stages(n_layers: int, n_stages: int, interleave: int = 1,
+                method: str = "uniform", layer_costs=None) -> StagePlan:
+    """Choose the layer boundaries for each virtual stage.
+
+    ``uniform`` balances layer COUNTS (remainder spread over the leading
+    chunks); ``parameters`` balances cumulative per-layer cost — boundary j
+    lands where the running cost crosses j/n_virtual of the total (reference
+    ``partition_balanced`` / ``ds_utils.partition_balanced``). Either way
+    every virtual stage gets >= 1 layer, so ``n_virtual > n_layers`` is a
+    planning error, not a silent empty stage.
+    """
+    n_virtual = n_stages * interleave
+    if n_stages < 1:
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if n_virtual > n_layers:
+        raise ValueError(
+            f"cannot split {n_layers} layers into {n_virtual} virtual stages "
+            f"({n_stages} stages x {interleave} interleave): every stage "
+            "needs at least one layer")
+    if method == "parameters" and layer_costs is not None:
+        costs = np.asarray(layer_costs, dtype=np.float64)
+        if costs.shape != (n_layers,):
+            raise ValueError(
+                f"layer_costs must have shape ({n_layers},), got {costs.shape}")
+        cum = np.concatenate([[0.0], np.cumsum(costs)])
+        bounds = [0]
+        for j in range(1, n_virtual):
+            target = cum[-1] * j / n_virtual
+            b = int(np.searchsorted(cum, target, side="left"))
+            # keep >= 1 layer per chunk on both sides of the boundary
+            b = max(b, bounds[-1] + 1)
+            b = min(b, n_layers - (n_virtual - j))
+            bounds.append(b)
+        bounds.append(n_layers)
+    elif method in ("uniform", "parameters"):
+        # parameters without cost data degrades to uniform
+        base, rem = divmod(n_layers, n_virtual)
+        bounds = [0]
+        for v in range(n_virtual):
+            bounds.append(bounds[-1] + base + (1 if v < rem else 0))
+    else:
+        raise ValueError(
+            f"unknown partition_method {method!r} (uniform|parameters)")
+    return StagePlan(n_layers=n_layers, n_stages=n_stages,
+                     interleave=interleave, boundaries=tuple(bounds))
+
+
+def _slice_layers(layers, lo: int, hi: int):
+    return jax.tree_util.tree_map(lambda x: x[lo:hi], layers)
+
+
+def split_params(params, plan: StagePlan, extras_owner: dict):
+    """Full param tree -> list of per-virtual-stage subset trees.
+
+    ``extras_owner`` maps each non-``"layers"`` top-level key to ``"first"``
+    or ``"last"``; keys absent from the tree (e.g. ``lm_head`` on a tied
+    model) are ignored by construction because iteration walks the tree.
+    """
+    stage_trees = []
+    for v in range(plan.n_virtual):
+        lo, hi = plan.layer_range(v)
+        tree = {"layers": _slice_layers(params["layers"], lo, hi)}
+        for k in params:
+            if k == "layers":
+                continue
+            owner = extras_owner.get(k)
+            if owner is None:
+                raise ValueError(
+                    f"param key {k!r} has no stage owner in "
+                    f"pipeline_extras_owner {sorted(extras_owner)}")
+            if (owner == "first" and v == 0) or (
+                    owner == "last" and v == plan.n_virtual - 1):
+                tree[k] = params[k]
+        stage_trees.append(tree)
+    return stage_trees
+
+
+def merge_params(stage_trees, plan: StagePlan):
+    """Inverse of :func:`split_params`: reassemble the single-program tree."""
+    import jax.numpy as jnp
+
+    layer_slices = [t["layers"] for t in stage_trees]
+    layers = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *layer_slices)
+    merged = {"layers": layers}
+    for t in stage_trees:
+        for k, leaf in t.items():
+            if k != "layers":
+                merged[k] = leaf
+    return merged
+
+
+def stage_boxes(params_template, plan: StagePlan, v: int) -> dict:
+    """Checkpoint boxes for virtual stage v: maps the leaf keystr of every
+    ``layers`` leaf in the STAGE tree to ``(dim0_offset, global_shape)`` so
+    fragments land at their global layer coordinates in the manifest index —
+    a merged (different-S) restore then reassembles them with the ordinary
+    overlap-pasting loader, no stage awareness needed.
+    """
+    lo, _hi = plan.layer_range(v)
+    boxes = {}
+    layers = params_template["layers"]
+    # offset fully determines the placement; the box extent comes from the
+    # fragment's own data shape at collect time
+    for path, leaf in jax.tree_util.tree_flatten_with_path(layers)[0]:
+        key = "['layers']" + jax.tree_util.keystr(path)
+        shape = tuple(np.shape(leaf))
+        boxes[key] = (lo, (plan.n_layers,) + shape[1:])
+    return boxes

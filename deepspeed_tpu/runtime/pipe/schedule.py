@@ -1,0 +1,121 @@
+"""Deterministic MPMD pipeline schedules.
+
+An instruction is ``Instr(t, v, op, mb)``: at logical tick ``t`` virtual
+stage ``v`` runs ``op`` ("F" forward / "B" backward) for microbatch ``mb``.
+The closed-form tick assignments below give every data dependency a strictly
+smaller ``t`` than its consumer, so executing each thread's instructions in
+``(t, v, op)`` order — with blocking recvs for cross-thread edges — is
+deadlock-free by construction. :func:`validate_schedule` proves it for a
+concrete (P, M) by simulating the dependency graph.
+
+Tick formulas (P = number of virtual stages, M = microbatches):
+
+- GPipe (fill/drain):  ``F(v, i)`` at ``t = v + i``;
+  ``B(v, j)`` at ``t = (M + P - 1) + (P - 1 - v) + j``
+- 1F1B (same slots as the in-jit ``parallel/pipeline_1f1b.py`` schedule):
+  warmup ``F(v, i)`` at ``t = v + i`` while ``i < P - v``, steady
+  ``F(v, i)`` at ``t = 2i + v``, ``B(v, j)`` at ``t = 2j + 2P - 1 - v``
+- interleaved: plain 1F1B over ``P = S * interleave`` virtual stages with
+  virtual stage v pinned to thread ``v % S`` (each thread owns every S-th
+  chunk, Megatron-style).
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+Instr = namedtuple("Instr", ("t", "v", "op", "mb"))
+
+
+def _gpipe(P: int, M: int) -> list:
+    out = []
+    for v in range(P):
+        for i in range(M):
+            out.append(Instr(v + i, v, "F", i))
+        for j in range(M):
+            out.append(Instr((M + P - 1) + (P - 1 - v) + j, v, "B", j))
+    return out
+
+
+def _one_f_one_b(P: int, M: int) -> list:
+    out = []
+    for v in range(P):
+        warmup = min(M, P - v)
+        for i in range(M):
+            t = v + i if i < warmup else 2 * i + v
+            out.append(Instr(t, v, "F", i))
+        for j in range(M):
+            out.append(Instr(2 * j + 2 * P - 1 - v, v, "B", j))
+    return out
+
+
+def build_schedule(schedule: str, n_virtual: int, n_micro: int) -> list:
+    """Full instruction list, sorted by ``(t, v, op, mb)``."""
+    if n_virtual < 1 or n_micro < 1:
+        raise ValueError(
+            f"need >= 1 virtual stage and >= 1 microbatch, got "
+            f"{n_virtual}/{n_micro}")
+    if schedule == "gpipe":
+        instrs = _gpipe(n_virtual, n_micro)
+    elif schedule == "1f1b":
+        instrs = _one_f_one_b(n_virtual, n_micro)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r} (gpipe|1f1b)")
+    return sorted(instrs)
+
+
+def thread_program(instrs: list, thread: int, n_stages: int) -> list:
+    """The instruction sequence one stage thread executes, in tick order."""
+    return [i for i in instrs if i.v % n_stages == thread]
+
+
+def validate_schedule(instrs: list, n_virtual: int, n_stages: int,
+                      n_micro: int) -> None:
+    """Simulate per-thread in-order execution against the dependency graph
+    (F(v,i) needs F(v-1,i); B(v,j) needs B(v+1,j) and F(v,j)) and raise on
+    deadlock or a missing/duplicate instruction."""
+    want = {(v, op, m) for v in range(n_virtual)
+            for op in ("F", "B") for m in range(n_micro)}
+    got = [(i.v, i.op, i.mb) for i in instrs]
+    if len(got) != len(set(got)) or set(got) != want:
+        raise ValueError(
+            f"schedule is not a permutation of every (stage, op, microbatch):"
+            f" {len(got)} instrs for {len(want)} slots")
+    programs = [thread_program(instrs, s, n_stages) for s in range(n_stages)]
+    cursors = [0] * n_stages
+    done: set = set()
+    total = len(instrs)
+    while len(done) < total:
+        progressed = False
+        for s in range(n_stages):
+            while cursors[s] < len(programs[s]):
+                ins = programs[s][cursors[s]]
+                deps = []
+                if ins.op == "F" and ins.v > 0:
+                    deps.append((ins.v - 1, "F", ins.mb))
+                if ins.op == "B":
+                    deps.append((ins.v, "F", ins.mb))
+                    if ins.v < n_virtual - 1:
+                        deps.append((ins.v + 1, "B", ins.mb))
+                if any(d not in done for d in deps):
+                    break
+                done.add((ins.v, ins.op, ins.mb))
+                cursors[s] += 1
+                progressed = True
+        if not progressed:
+            stuck = [programs[s][cursors[s]] for s in range(n_stages)
+                     if cursors[s] < len(programs[s])]
+            raise ValueError(f"schedule deadlocks; blocked heads: {stuck}")
+
+
+def bubble_fraction(schedule: str, n_virtual: int, n_micro: int) -> float:
+    """Analytic idle fraction of the schedule's slot grid (the measured
+    counterpart is stepscope's ``train_pipe_bubble_fraction``)."""
+    P, M = n_virtual, n_micro
+    if P <= 1:
+        return 0.0
+    if schedule == "gpipe":
+        # per stage: 2M busy slots in a 2(M + P - 1) wall
+        return float(P - 1) / (M + P - 1)
+    # 1f1b: 2(P-1) idle slots against 2M busy per stage
+    return 2.0 * (P - 1) / (2.0 * M + 2.0 * (P - 1))

@@ -1,0 +1,106 @@
+"""Activation/grad transport between stage programs.
+
+The seam the MPMD runtime sends tensors through. Shapes today: one process,
+one thread per stage, in-process queues — which is enough to prove the
+schedule, the parity, and the failure semantics on CPU. The interface is a
+point-to-point tagged channel (src stage, dst stage, kind, microbatch), the
+same addressing a ``jax.device_put``-between-meshes or collective-permute
+transport needs, so swapping the wire does not touch the executor.
+
+Send is non-blocking (the producer's arrays are already dispatched device
+futures; handing them over costs a queue append). Recv blocks with an abort
+poll so a dead peer converts into :class:`TransportAborted` instead of a
+hang, and reports its wait time — the executor accounts it into the
+``pipe_bubble`` stepscope phase.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+# channel kinds
+ACT = "act"          # forward activations, stage v -> v+1
+GRAD = "grad"        # activation cotangents, stage v+1 -> v
+
+
+class TransportAborted(RuntimeError):
+    """The step was aborted (peer crashed / shutdown) while blocked in recv."""
+
+
+class Transport:
+    """Point-to-point tagged channels between virtual stages."""
+
+    def send(self, src: int, dst: int, kind: str, mb: int, payload) -> None:
+        raise NotImplementedError
+
+    def recv(self, src: int, dst: int, kind: str, mb: int):
+        """Block until the tagged payload arrives.
+
+        Returns ``(payload, waited_seconds)``.
+        """
+        raise NotImplementedError
+
+    def abort(self) -> None:
+        """Wake every blocked recv with :class:`TransportAborted`."""
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Drop in-flight payloads and clear the abort flag (new step)."""
+        raise NotImplementedError
+
+
+class InProcTransport(Transport):
+    """Queues + threads implementation (one process, CPU-testable)."""
+
+    def __init__(self, poll_interval_s: float = 0.05):
+        self._poll = float(poll_interval_s)
+        self._lock = threading.Lock()
+        self._chans: dict = {}
+        self._abort = threading.Event()
+
+    def _chan(self, tag) -> queue.Queue:
+        with self._lock:
+            ch = self._chans.get(tag)
+            if ch is None:
+                ch = self._chans[tag] = queue.Queue()
+            return ch
+
+    def send(self, src, dst, kind, mb, payload):
+        if self._abort.is_set():
+            raise TransportAborted(f"send({kind} {src}->{dst} mb{mb}) after abort")
+        self._chan((src, dst, kind, mb)).put(payload)
+
+    def recv(self, src, dst, kind, mb):
+        ch = self._chan((src, dst, kind, mb))
+        t0 = time.perf_counter()
+        while True:
+            if self._abort.is_set():
+                raise TransportAborted(
+                    f"recv({kind} {src}->{dst} mb{mb}) aborted")
+            try:
+                payload = ch.get(timeout=self._poll)
+                return payload, time.perf_counter() - t0
+            except queue.Empty:
+                continue
+
+    def abort(self):
+        self._abort.set()
+
+    def reset(self):
+        with self._lock:
+            self._chans.clear()
+            self._abort.clear()
+
+
+class DeviceTransport(Transport):
+    """Placeholder for the cross-mesh wire (``jax.device_put`` between stage
+    meshes, or collective-permute once stages share a donut). Declared so the
+    config knob and the interface shape exist; selecting it is an explicit
+    error until a multi-device backend lands."""
+
+    def __init__(self, *_, **__):
+        raise NotImplementedError(
+            "pipeline.transport='device' is reserved for the cross-mesh "
+            "transport; use 'inproc' (see docs/PIPELINE.md)")

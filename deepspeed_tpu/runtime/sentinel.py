@@ -359,8 +359,13 @@ class SentinelPolicy:
 
 
 # ----------------------------------------------------------------- liveness
-def heartbeat_path(state_dir: str, rank: int) -> str:
-    return os.path.join(state_dir, f"heartbeat_{int(rank)}.json")
+def heartbeat_path(state_dir: str, rank) -> str:
+    """Beacon file for a worker rank. ``rank`` is an int for process ranks
+    or a string like ``"0_s1"`` for a per-stage beacon (rank 0, pipeline
+    stage thread 1) — the MPMD runtime beats one per stage thread so a
+    single wedged stage goes stale on its own."""
+    rank = rank if isinstance(rank, str) else int(rank)
+    return os.path.join(state_dir, f"heartbeat_{rank}.json")
 
 
 class Heartbeat:

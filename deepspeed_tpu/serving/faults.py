@@ -84,6 +84,7 @@ POINT_CKPT_LOAD = "ckpt.load"        # load/verify entry
 POINT_TRAIN_DISPATCH = "train.dispatch"  # fused train step launch/fence
 POINT_TRAIN_GRADS = "train.grads"        # grad computation (transient anomaly)
 POINT_DATA_BATCH = "data.batch"          # batch admission (content-keyed)
+POINT_PIPE_STAGE = "pipe.stage"          # MPMD stage thread, per instruction
 
 POINTS = (
     POINT_DISPATCH,
@@ -100,6 +101,7 @@ POINTS = (
     POINT_TRAIN_DISPATCH,
     POINT_TRAIN_GRADS,
     POINT_DATA_BATCH,
+    POINT_PIPE_STAGE,
 )
 
 # Kinds whose firing returns the kind string to the seam (which applies the

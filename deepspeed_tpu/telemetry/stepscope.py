@@ -165,6 +165,7 @@ class StepScope:
         self._compile_hist = None
         self._c_goodput = None
         self._g_overlap = self._g_goodput = self._g_skew = None
+        self._g_pipe_bubble = None
         self._g_mfu = self._g_phase_mfu = None
         self._g_phase_hbm = self._g_peak_hbm = None
         if self.enabled:
@@ -190,7 +191,12 @@ class StepScope:
                 "productive step seconds / wall seconds since scope start")
             self._g_skew = reg.gauge(
                 "train_step_skew_ratio",
-                "max/min per-host mean step time (straggler indicator)")
+                "max/min per-host mean step time (straggler indicator); "
+                "stage=<s> rows: per-pipeline-stage busy/mean-busy ratio")
+            self._g_pipe_bubble = reg.gauge(
+                "train_pipe_bubble_fraction",
+                "measured idle fraction of the pipeline schedule window "
+                "(fill/drain + recv-wait, averaged over stage threads)")
             self._g_mfu = reg.gauge(
                 "train_mfu", "model FLOPs utilization over measured steps")
             self._g_phase_mfu = reg.gauge(
@@ -468,6 +474,22 @@ class StepScope:
     def goodput(self) -> float:
         wall = max(time.perf_counter() - self._t_created, 1e-9)
         return max(0.0, min(1.0, self._productive_s / wall))
+
+    def note_pipe_stages(self, busy: list, wall: float) -> None:
+        """Per-step pipeline occupancy (MPMD runtime): ``busy[s]`` is stage
+        thread s's measured program-execution seconds inside a ``wall``-long
+        schedule window. Sets the measured bubble fraction and per-stage
+        skew rows (``train_step_skew_ratio{stage=s}`` = busy_s / mean busy —
+        an unbalanced partition shows up as rows far from 1.0)."""
+        if not self.enabled or not busy or wall <= 0.0:
+            return
+        idle = [max(0.0, wall - b) for b in busy]
+        self._g_pipe_bubble.set(
+            min(1.0, sum(idle) / (len(busy) * wall)))
+        mean_busy = sum(busy) / len(busy)
+        if mean_busy > 0:
+            for s, b in enumerate(busy):
+                self._g_skew.set(b / mean_busy, stage=str(s))
 
     def refresh_skew(self) -> float:
         """Per-host step-time skew (comms-logging straggler machinery): an
