@@ -1074,6 +1074,12 @@ def serving_bench_main():
     # request retires, later prefills splice the shared blocks instead of
     # recomputing them
     shared_prefix = int(e.get("BENCH_SERVING_SHARED_PREFIX", 0))
+    # tiered KV cache (--kv-tier): shrink the HBM pool so the shared-prefix
+    # working set overflows it by >=3x, and let the engine demote evicted
+    # prefix blocks host-ward instead of dropping them (docs/SERVING.md)
+    kv_tier = e.get("BENCH_SERVING_KV_TIER", "") not in ("", "0")
+    if kv_tier and shared_prefix == 0:
+        shared_prefix = 2 * block  # two full blocks per prefix group
 
     tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "runs",
@@ -1084,12 +1090,24 @@ def serving_bench_main():
         raise SystemExit(f"BENCH_SERVING_SHARED_PREFIX={shared_prefix} must "
                          f"be < the max prompt length ({max_prompt})")
     mbs = -(-(max_prompt + max_new) // block)
+    num_blocks = max_seqs * mbs + 1
+    if kv_tier:
+        # tiny HBM budget: roughly two in-flight requests' worth, so the
+        # n_groups x (prefix + tails) working set is >=3x the pool and
+        # every reuse after churn crosses a tier boundary
+        num_blocks = 2 * mbs + 1
     rcfg = RaggedConfig(
         max_tokens_per_step=budget, max_seqs=max_seqs, block_size=block,
-        num_blocks=max_seqs * mbs + 1, max_blocks_per_seq=mbs,
+        num_blocks=num_blocks, max_blocks_per_seq=mbs,
         decode_run_ahead=ahead, prefill_tile=tile,
         fused_chunk=fused, pipeline_depth=depth,
-        enable_prefix_cache=shared_prefix > 0)
+        enable_prefix_cache=shared_prefix > 0 or kv_tier,
+        kv_tier=kv_tier,
+        kv_tier_host_blocks=4 * mbs,
+        kv_tier_disk_blocks=8 * mbs,
+        kv_tier_dir=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "runs", "kvtier",
+            f"bench-{os.getpid()}"))
     engine = RaggedInferenceEngine(
         model=lambda ctx: llama.build(model_cfg, ctx=ctx),
         ragged_config=rcfg, seed=0)
@@ -1100,13 +1118,38 @@ def serving_bench_main():
             max_queue_tokens=int(e.get("BENCH_SERVING_QUEUE_TOKENS", 2048))))
 
     rng = np.random.default_rng(0)
-    prefix = rng.integers(0, model_cfg.vocab_size, (shared_prefix,),
-                          dtype=np.int32).tolist()
-    prompts = [prefix + rng.integers(
-        0, model_cfg.vocab_size,
-        (max(1, int(prompt_lens[i % len(prompt_lens)]) - shared_prefix),),
-        dtype=np.int32).tolist() for i in range(n_req)]
-    rng.shuffle(prompts)
+    if kv_tier:
+        # n_groups distinct shared prefixes, every unique prompt issued
+        # TWICE with identical sampling params: deterministic per-request
+        # seeds make the pair token-identical whether the second admission
+        # re-prefilled, spliced HBM blocks, or restored demoted tiers —
+        # so occurrence parity is the end-to-end tiering check
+        n_groups = 3
+        n_req = int(e.get("BENCH_SERVING_REQUESTS", 2 * n_groups * 4))
+        n_uniq = max(n_groups, n_req // 2)
+        prefixes = [rng.integers(0, model_cfg.vocab_size, (shared_prefix,),
+                                 dtype=np.int32).tolist()
+                    for _ in range(n_groups)]
+        reqs = []  # (uniq_id, prompt, sampling-extras)
+        for u in range(n_uniq):
+            p = prefixes[u % n_groups] + rng.integers(
+                0, model_cfg.vocab_size, (max_prompt - shared_prefix,),
+                dtype=np.int32).tolist()
+            extra = {} if u % 2 == 0 else \
+                {"temperature": 0.9, "top_k": 20, "seed": 1000 + u}
+            reqs.append((u, p, extra))
+        reqs = [reqs[i % n_uniq] for i in range(n_req)]
+        rng.shuffle(reqs)
+        prompts = [r[1] for r in reqs]
+    else:
+        prefix = rng.integers(0, model_cfg.vocab_size, (shared_prefix,),
+                              dtype=np.int32).tolist()
+        prompts = [prefix + rng.integers(
+            0, model_cfg.vocab_size,
+            (max(1, int(prompt_lens[i % len(prompt_lens)]) - shared_prefix),),
+            dtype=np.int32).tolist() for i in range(n_req)]
+        rng.shuffle(prompts)
+        reqs = [(i, p, {}) for i, p in enumerate(prompts)]
     # open-loop schedule: exponential inter-arrival gaps, fixed before the
     # clock starts so client-side jitter can't thin the offered load
     gaps = rng.exponential(1.0 / rate, n_req)
@@ -1115,14 +1158,14 @@ def serving_bench_main():
     results = []  # dicts: {rejected, ttft, token_times, useful}
     results_lock = threading.Lock()
 
-    def one_request(prompt):
+    def one_request(prompt, extra=None, uniq_id=None):
         conn = http.client.HTTPConnection(frontend.host, frontend.port,
                                           timeout=120)
         body = json.dumps({"prompt": prompt, "max_tokens": max_new,
-                           "stream": True})
+                           "stream": True, **(extra or {})})
         t_send = time.perf_counter()
         rec = {"rejected": False, "ttft": None, "token_times": [],
-               "useful": 0}
+               "useful": 0, "tokens": [], "uniq_id": uniq_id}
         try:
             conn.request("POST", "/v1/completions", body=body,
                          headers={"Content-Type": "application/json"})
@@ -1146,10 +1189,17 @@ def serving_bench_main():
                     if rec["ttft"] is None:
                         rec["ttft"] = now - t_send
                     rec["token_times"].append(now)
+                    rec["tokens"].append(frame["token"])
             rec["useful"] = len(prompt) + len(rec["token_times"])
         finally:
             conn.close()
         return rec
+
+    if kv_tier:
+        # serial per-group warmup: publish each prefix once before the open
+        # loop so group misses are the warmups, not a thundering-herd race
+        for g in range(n_groups):
+            one_request(prefixes[g] + [1, 2, 3], extra={"max_tokens": 1})
 
     threads = []
     t0 = time.perf_counter()
@@ -1158,8 +1208,8 @@ def serving_bench_main():
         if delay > 0:
             time.sleep(delay)
 
-        def fire(p=prompts[i]):
-            rec = one_request(p)
+        def fire(r=reqs[i]):
+            rec = one_request(r[1], extra=r[2], uniq_id=r[0])
             with results_lock:
                 results.append(rec)
 
@@ -1187,6 +1237,31 @@ def serving_bench_main():
         "serving_prefix_cache_evictions": engine.allocator.evictions,
         "serving_tokens_scheduled": engine.tokens_scheduled,
     } if shared_prefix > 0 else {}
+    kv_tier_stats = {}
+    if kv_tier:
+        st = engine.kv_tier_stats() or {}
+        # occurrence parity: both sends of a unique prompt must stream the
+        # same tokens — the tiered splice may never show in the output
+        by_uniq = {}
+        for r in done:
+            if r.get("uniq_id") is not None:
+                by_uniq.setdefault(r["uniq_id"], []).append(r["tokens"])
+        pairs = [v for v in by_uniq.values() if len(v) >= 2]
+        parity_ok = all(all(t == v[0] for t in v[1:]) for v in pairs)
+        promoted = (st.get("promoted_admissions_host", 0)
+                    + st.get("promoted_admissions_disk", 0))
+        kv_tier_stats = {
+            "enabled": True,
+            "hbm_blocks": rcfg.num_blocks,
+            "combined_hit_rate":
+                round(engine.prefix_hits / decided, 4) if decided else 0.0,
+            "hits_from_hbm": engine.prefix_hits - promoted,
+            "hits_via_host_restore": st.get("promoted_admissions_host", 0),
+            "hits_via_disk_restore": st.get("promoted_admissions_disk", 0),
+            "parity_pairs_checked": len(pairs),
+            "parity_ok": parity_ok,
+            **{f"kvtier_{k}": v for k, v in st.items()},
+        }
     # memory-ledger picture BEFORE close() tears the ledger down: per-owner
     # bytes + the final census gap (the leak detector's reading for the run)
     led = telemetry.TELEMETRY.memledger
@@ -1202,12 +1277,28 @@ def serving_bench_main():
             "drift_alarm": census["drift_alarm"],
             "oom_reports": list(led.oom_reports),
         }
+        if kv_tier:
+            # per-tier residency so the off-device bytes the census excludes
+            # from reconciliation are still visible next to the device pool
+            st = engine.kv_tier_stats() or {}
+            memory["kv_tier_bytes"] = {
+                "host": st.get("host_bytes", 0),
+                "disk": st.get("disk_bytes", 0),
+            }
+            memory["offdevice_bytes"] = census.get("offdevice_bytes", 0)
+    if kv_tier and engine._kvtier is not None:
+        # per-pid spill directory: drop it with the run so repeated bench
+        # invocations don't accumulate dead records under runs/kvtier/
+        engine._kvtier.close()
+        import shutil
+        shutil.rmtree(rcfg.kv_tier_dir, ignore_errors=True)
     telemetry.TELEMETRY.close()
     print(json.dumps({
         "metric": "serving_frontend_poisson",
         "serving_requests": n_req,
         "serving_rate_rps": rate,
         **cache_stats,
+        **({"kv_tier": kv_tier_stats} if kv_tier_stats else {}),
         "serving_completed": len(done),
         "serving_rejected": rejected,
         "serving_rejected_rate": round(rejected / max(1, len(results)), 4),
@@ -2611,6 +2702,11 @@ def main():
                       file=sys.stderr)
                 return 2
             os.environ["BENCH_SERVING_SHARED_PREFIX"] = val[0]
+        if "--kv-tier" in sys.argv:
+            # hierarchical KV-cache tiering trial: tiny HBM pool + host/disk
+            # tiers, repeated shared-prefix prompts, occurrence-parity and
+            # demotion/promotion/prefetch counters in the JSON verdict
+            os.environ["BENCH_SERVING_KV_TIER"] = "1"
         result, err = run_serving_subprocess()
         if result is None:
             print(f"serving bench failed:\n{_err_text(err)}", file=sys.stderr)

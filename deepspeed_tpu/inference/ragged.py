@@ -25,10 +25,11 @@ drop-in optimization point.
 
 from __future__ import annotations
 
+import pickle
 import random
 import time
 import weakref
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 from typing import Any, Callable
 
 import jax
@@ -84,6 +85,12 @@ class BlockedAllocator:
         # an object with on_publish(key) / on_evict(key), called on the
         # engine thread as keys enter/leave the index. None = standalone.
         self.listener = None
+        # optional tiering hook: demote_hook(block, key) -> bool is called
+        # as an LRU eviction reclaims a published block, WHILE the payload
+        # is still intact — True means the block was captured into a lower
+        # tier (inference/kvtier.py) rather than dropped. None = untiered
+        # (the eviction path is bit-identical to the pre-tiering engine).
+        self.demote_hook = None
 
     @property
     def free_blocks(self) -> int:
@@ -118,10 +125,26 @@ class BlockedAllocator:
         del self._lru[b]
         key = self._keys.pop(b)
         del self._index[key]
-        self._free.append(b)
+        demoted = False
+        if self.demote_hook is not None:
+            # tiering: capture the payload device->host NOW — once the id
+            # is back on the free list the next allocation may rewrite it
+            try:
+                demoted = bool(self.demote_hook(b, key))
+            except Exception:  # noqa: BLE001 - demotion is best-effort
+                demoted = False
         self.evictions += 1
         if self.listener is not None:
-            self.listener.on_evict(key)
+            # notify BEFORE the id returns to the free list: a cluster-index
+            # entry must never promise a block its replica could already be
+            # rewriting. A captured block demotes (the key stays servable
+            # from a lower tier); an uncaptured one is a plain eviction.
+            on_demote = getattr(self.listener, "on_demote", None)
+            if demoted and on_demote is not None:
+                on_demote(key)
+            else:
+                self.listener.on_evict(key)
+        self._free.append(b)
 
     def shrink_retained(self, budget: int) -> int:
         """Evict LRU cached blocks until at most ``budget`` refcount-0
@@ -294,6 +317,35 @@ class RaggedConfig:
     # hang — external pressure is expected to lift, and when it doesn't the
     # operator needs a loud failure, not an idle loop). 0 disables the alarm.
     headroom_stall_alarm_ticks: int = 1000
+    # ---- hierarchical KV-cache tiering (inference/kvtier.py) ----
+    # three-tier prefix cache: HBM (tier 0, the pool above) -> bounded
+    # host-RAM arena (tier 1) -> disk spill directory (tier 2). LRU eviction
+    # becomes *demotion* (the evicted block's payload is gathered to host
+    # before the id is reused) and admission *promotes* demoted chain links
+    # back through the standard allocate->scatter->publish path when the
+    # restore_beats_prefill cost model favors it — token-identical either
+    # way. Requires enable_prefix_cache. Off by default: eviction drops
+    # payloads exactly as before, bit-identical to the untiered engine.
+    kv_tier: bool = False
+    # tier-1 budget in KV blocks (must be > 0 when kv_tier is on)
+    kv_tier_host_blocks: int = 64
+    # tier-2 budget in records; 0 disables the disk tier (host overflow is
+    # then dropped, which is exactly the old eviction for those blocks)
+    kv_tier_disk_blocks: int = 0
+    # spill directory; swept for torn temp files at engine startup
+    kv_tier_dir: str = "runs/kvtier"
+    # modeled tier-crossing bandwidths for the promotion cost model
+    # (host<->device link, and disk read). <= 0 = unknown, which
+    # conservatively never restores from that tier.
+    kv_tier_host_gbps: float = 100.0
+    kv_tier_disk_gbps: float = 8.0
+    # modeled prefill throughput the restore competes against (the same
+    # constant ClusterConfig.prefill_tokens_per_s models for wire transfers)
+    kv_tier_prefill_tokens_per_s: float = 50000.0
+    # router-kicked async prefetch: stage disk records up to the host arena
+    # while the request rides the queue, so the admission-time restore only
+    # pays the host->device hop
+    kv_tier_prefetch: bool = True
 
     @property
     def max_seq_len(self) -> int:
@@ -433,6 +485,36 @@ class KVHandoff:
                 n += a.nbytes
         return n
 
+    def to_bytes(self) -> bytes:
+        """Serialize the record with length+sha256 framing
+        (``kvtier.frame_bytes``) so the disk spill tier and any cross-host
+        transport share one end-to-end integrity check — a torn or
+        bit-flipped buffer fails loudly in ``from_bytes`` instead of
+        splicing corrupt KV."""
+        from deepspeed_tpu.inference.kvtier import HANDOFF_MAGIC, frame_bytes
+
+        body = pickle.dumps({f.name: getattr(self, f.name)
+                             for f in fields(self)}, protocol=4)
+        return HANDOFF_MAGIC + frame_bytes(body)
+
+    @classmethod
+    def from_bytes(cls, buf: bytes) -> "KVHandoff":
+        """Inverse of ``to_bytes``. Raises ValueError for anything short of
+        a byte-exact record (bad magic, torn frame, digest mismatch,
+        trailing garbage)."""
+        from deepspeed_tpu.inference.kvtier import (
+            HANDOFF_MAGIC,
+            unframe_bytes,
+        )
+
+        buf = bytes(buf)
+        if not buf.startswith(HANDOFF_MAGIC):
+            raise ValueError("not a KVHandoff record (bad magic)")
+        body, end = unframe_bytes(buf, len(HANDOFF_MAGIC))
+        if end != len(buf):
+            raise ValueError("trailing bytes after KVHandoff frame")
+        return cls(**pickle.loads(body))
+
 
 @dataclass
 class PrefixPayload:
@@ -494,6 +576,29 @@ class RaggedInferenceEngine:
             self.cfg.num_blocks, self.cfg.block_size, dtype
         )
         self.allocator = BlockedAllocator(self.cfg.num_blocks)
+        # ---- hierarchical KV tiering (inference/kvtier.py) ----
+        # tier store + allocator demote hook; None with kv_tier off, and
+        # the allocator's eviction path is then bit-identical to before
+        self._kvtier = None
+        self._kvtier_seen: dict[str, int] = {}
+        if self.cfg.kv_tier:
+            if not self.cfg.enable_prefix_cache:
+                raise ValueError("kv_tier requires enable_prefix_cache "
+                                 "(the tiers hold demoted prefix blocks)")
+            if self.cfg.kv_tier_host_blocks <= 0:
+                raise ValueError("kv_tier needs kv_tier_host_blocks > 0")
+            from deepspeed_tpu.inference.kvtier import KVTierStore
+
+            self._kvtier = KVTierStore(
+                host_blocks=self.cfg.kv_tier_host_blocks,
+                disk_blocks=self.cfg.kv_tier_disk_blocks,
+                directory=self.cfg.kv_tier_dir,
+                host_gbps=self.cfg.kv_tier_host_gbps,
+                disk_gbps=self.cfg.kv_tier_disk_gbps,
+                prefill_tokens_per_s=self.cfg.kv_tier_prefill_tokens_per_s,
+                bytes_per_token=self.kv_bytes_per_token(),
+            )
+            self.allocator.demote_hook = self._demote_block
         # row max_seqs is the all-zeros padding row -> scratch block 0
         self.block_tables = np.zeros(
             (self.cfg.max_seqs + 1, self.cfg.max_blocks_per_seq), np.int32
@@ -988,6 +1093,27 @@ class RaggedInferenceEngine:
 
         led.register_provider("staging_buffers", "ragged/staging_cache",
                               _staging_bytes)
+        if self._kvtier is not None:
+            def _host_tier_bytes():
+                eng = ref()
+                if eng is None or eng._kvtier is None:
+                    return None
+                return eng._kvtier.host_nbytes
+
+            def _disk_tier_bytes():
+                eng = ref()
+                if eng is None or eng._kvtier is None:
+                    return None
+                return eng._kvtier.disk_nbytes
+
+            # off-device owners: host-RAM/disk bytes show in the breakdown
+            # and gauges but are EXCLUDED from the census reconciliation
+            # against jax.live_arrays() — they are not device bytes, and
+            # counting them would fake overattribution
+            led.register_provider("host_kv_tier", "ragged/kvtier_host_arena",
+                                  _host_tier_bytes, offdevice=True)
+            led.register_provider("disk_kv_tier", "ragged/kvtier_disk_spill",
+                                  _disk_tier_bytes, offdevice=True)
         # retained prefix blocks and parked handoff blocks live INSIDE the
         # kv_pool arrays registered above — carve-outs, so the breakdown
         # shows them as their own owners while the attributed total still
@@ -1286,6 +1412,11 @@ class RaggedInferenceEngine:
         prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
         if not prompt:
             return None
+        if self._kvtier is not None:
+            # a demoted chain is still this replica's to export: promote it
+            # back to HBM first so the cluster index's tier-aware promises
+            # stay serveable
+            self._tier_promote(prompt)
         hit = self._match_prefix(prompt)
         if not hit:
             return None
@@ -1349,6 +1480,130 @@ class RaggedInferenceEngine:
                 break
             m += 1
         return m * bs
+
+    # --------------------------------- hierarchical KV tiering (kvtier.py)
+    def _demote_block(self, block: int, key) -> bool:
+        """Allocator demote hook: gather one evicted block's payload
+        device->host and park it in the tier store. Runs on the engine
+        thread inside ``_evict_lru`` while the payload is still intact;
+        True = captured (the cluster index hears a demotion, not a drop)."""
+        store = self._kvtier
+        if store is None:
+            return False
+        try:
+            payload = self._gather_blocks([block])
+        except Exception:  # noqa: BLE001 - a failed gather is a plain evict
+            return False
+        return store.demote(key, payload)
+
+    def _chain_keys(self, prompt: list[int]) -> list:
+        """The prompt's full-block hash-chain keys, root-first, capped one
+        token short of the prompt exactly like ``_match_prefix``."""
+        bs = self.cfg.block_size
+        keys = []
+        key = None
+        for i in range((len(prompt) - 1) // bs):
+            key = (key, tuple(prompt[i * bs:(i + 1) * bs]))
+            keys.append(key)
+        return keys
+
+    def _tier_promote(self, prompt: list[int]) -> int:
+        """Restore demoted chain links of ``prompt`` from the host/disk
+        tiers back into the HBM prefix index, in chain order, when the
+        cost model says the restore beats re-prefilling them. The restore
+        is the ``import_prefix`` template — allocate -> scatter -> publish
+        -> refcount-0 into the evictable LRU — so a subsequent
+        ``_match_prefix`` splices promoted blocks exactly like blocks that
+        never left HBM (token identity is free). Returns blocks promoted.
+
+        Budget discipline matches ``import_prefix``: promotion draws only
+        from unreserved allocatable blocks, and the allocation itself may
+        demote colder LRU entries — the tiers churn, admission never
+        starves."""
+        store = self._kvtier
+        if store is None:
+            return 0
+        bs = self.cfg.block_size
+        alloc = self.allocator
+        t0 = time.perf_counter()
+        # contiguous-from-root restorable run: links already in HBM pass
+        # through; the first link in neither HBM nor a tier ends the chain
+        cand: list[tuple[Any, Any, int]] = []  # (key, payload, tier)
+        for key in self._chain_keys(prompt):
+            if alloc.lookup(key) is not None:
+                continue
+            tier = store.tier_of(key)
+            if tier == 0:
+                break  # held nowhere: the contiguous chain ends here
+            if not store.should_restore(bs, tier):
+                # a held link the cost model declines also ends the run —
+                # splicing past a gap is impossible anyway
+                store.restore_declined += 1
+                break
+            got = store.fetch(key)
+            if got is None:
+                break  # raced an overflow drop between tier_of and fetch
+            cand.append((key, got[0], got[1]))
+        budget = max(0, alloc.free_blocks - self._reserved)
+        cand = cand[:budget]
+        if not cand:
+            return 0
+        blocks = alloc.allocate(len(cand))
+        payload = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=1), *[p for _, p, _ in cand])
+        self._scatter_blocks(blocks, payload)
+        for b, (key, _, _) in zip(blocks, cand):
+            alloc.publish(b, key)
+        alloc.free(blocks)  # refcount 0 + published -> evictable LRU (MRU)
+        dt = time.perf_counter() - t0
+        tiers = [t for _, _, t in cand]
+        store.note_restored(tiers, dt)
+        if self.telemetry.enabled:
+            self.telemetry.histogram(
+                "kvtier_restore_seconds",
+                "wall time of one tiered prefix restore (gather from tier, "
+                "scatter to HBM, publish)",
+            ).observe(dt, tier="disk" if 2 in tiers else "host")
+        return len(cand)
+
+    def _tier_admit(self, seq: _SeqState) -> None:
+        """Admission-time tier pass, just before ``_match_prefix``: resolve
+        the request's async prefetch (hit when staging finished during the
+        queue wait, abandoned when admission outran it) and run the
+        synchronous promotion — cheap when the prefetch landed, a full
+        tier read when it didn't. Either way ``_match_prefix`` then sees
+        the restored links in the ordinary HBM index."""
+        store = self._kvtier
+        keys = self._chain_keys(seq.prompt)
+        if not keys:
+            return
+        store.note_admission(keys[-1])
+        self._tier_promote(seq.prompt)
+
+    def tier_prefetch_async(self, prompt_tokens) -> bool:
+        """Advisory cross-thread prefetch kick (the serving router calls
+        this at placement): queue a background staging job for the prompt's
+        chain links missing from HBM so their restore overlaps the queue
+        wait. Thread-safe — touches only the tier store (its own lock) and
+        the same racy-but-safe read-only index probes
+        ``cached_prefix_len`` already makes off-thread."""
+        store = self._kvtier
+        if store is None or not self.cfg.kv_tier_prefetch:
+            return False
+        prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
+        keys = self._chain_keys(prompt)
+        if not keys:
+            return False
+        pending = [k for k in keys if self.allocator.lookup(k) is None]
+        if not pending:
+            return False
+        return store.prefetch(pending, sig=keys[-1])
+
+    def kv_tier_stats(self) -> dict | None:
+        """Tier store counters/occupancy (None when tiering is off).
+        Thread-safe: the store snapshots under its own lock, so the
+        frontend's ``/debug/memory`` can read it off-thread."""
+        return None if self._kvtier is None else self._kvtier.stats()
 
     def _ensure_capacity(self, seq: _SeqState, upto: int) -> bool:
         """Grow seq's block table to cover positions [0, upto); False if the
@@ -3347,6 +3602,12 @@ class RaggedInferenceEngine:
                 # and starts the stall-duration alarm clock)
                 self._headroom_wait = True
                 break
+            if use_cache and self._kvtier is not None:
+                # tiered restore first (prefetch resolution + cost-model
+                # promotion): _match_prefix below then finds promoted links
+                # in the ordinary HBM index, so the splice — and the tokens
+                # — are identical to blocks that never left HBM
+                self._tier_admit(seq)
             hit: list[int] = self._match_prefix(seq.prompt) if use_cache else []
             if hit:
                 # take the references first: free_blocks counts refcount-0
@@ -3720,6 +3981,11 @@ class RaggedInferenceEngine:
         self._inflight_chunks.clear()
         self._staging_cache.clear()
         self.allocator = BlockedAllocator(self.cfg.num_blocks)
+        if self._kvtier is not None:
+            # the tier store SURVIVES reset: its records are keyed by exact
+            # token chains, valid for any allocator generation of the same
+            # params — demoted prefixes stay restorable after containment
+            self.allocator.demote_hook = self._demote_block
         if self._prefix_listener is not None:
             # fresh allocator has no published keys: tell the cluster index
             # to forget this replica, then keep listening
@@ -3848,6 +4114,30 @@ class RaggedInferenceEngine:
             g("prefix_cache_hit_rate",
               "fraction of admissions with a cached prefix").set(
                   self.prefix_hits / decided if decided else 0.0)
+        if self._kvtier is not None:
+            st = self._kvtier.stats()
+            g("kvtier_bytes", "bytes parked in the KV tier").set(
+                st["host_bytes"], tier="host")
+            g("kvtier_bytes", "bytes parked in the KV tier").set(
+                st["disk_bytes"], tier="disk")
+            g("kvtier_blocks", "KV blocks parked in the tier").set(
+                st["host_blocks"], tier="host")
+            g("kvtier_blocks", "KV blocks parked in the tier").set(
+                st["disk_blocks"], tier="disk")
+            seen = self._kvtier_seen
+            for name, help_ in (
+                ("demotions", "KV blocks demoted HBM->host on eviction"),
+                ("spills", "KV blocks spilled host->disk on overflow"),
+                ("promotions", "KV blocks promoted back into HBM"),
+                ("prefetch_hits",
+                 "admissions whose tier prefetch finished in time"),
+                ("prefetch_abandoned",
+                 "admissions that outran their tier prefetch"),
+            ):
+                delta = st[name] - seen.get(name, 0)
+                if delta > 0:
+                    tel.counter(f"kvtier_{name}_total", help_).inc(delta)
+                    seen[name] = st[name]
         hb = self.admission_headroom_blocks()
         if hb >= 0:
             g("kv_headroom_blocks",

@@ -97,8 +97,11 @@ def transfer_beats_prefill(tokens: int, bytes_per_token: int,
                            cfg: ClusterConfig) -> bool:
     """The bytes-vs-prefill-flops estimate: ship ``tokens`` worth of KV
     (``tokens * bytes_per_token`` bytes over the modeled channel) iff the
-    wire time undercuts re-running prefill for those tokens."""
-    if tokens <= 0:
+    wire time undercuts re-running prefill for those tokens. Conservative
+    on unknowns: an unreported bandwidth or prefill rate (-1/0) must never
+    transfer — a negative divisor would flip the inequality and claim a
+    free wire."""
+    if tokens <= 0 or cfg.transfer_gbps <= 0 or cfg.prefill_tokens_per_s <= 0:
         return False
     wire_s = tokens * bytes_per_token * 8.0 / (cfg.transfer_gbps * 1e9)
     prefill_s = tokens / cfg.prefill_tokens_per_s
@@ -153,6 +156,13 @@ class _IndexListener:
     def on_evict(self, key) -> None:
         self._index.evict(self._name, key)
 
+    def on_demote(self, key) -> None:
+        # tiered engines: the key left HBM but stays restorable from the
+        # replica's host/disk tiers — the index keeps the holder, marked
+        # demoted, instead of dropping the entry (fired BEFORE the block id
+        # is reusable, so the index never promises payload-less HBM blocks)
+        self._index.demote(self._name, key)
+
     def on_reset(self) -> None:
         self._index.drop_replica(self._name)
 
@@ -162,32 +172,46 @@ class ClusterPrefixIndex:
 
     Same hash-chained keying as the per-replica index — keys are
     ``(parent_key, tuple(block_tokens))`` exact-token tuples, fed verbatim
-    from allocator listeners — mapped to the *set of replica names* holding
-    each chain link. ``best_holder`` walks a prompt's chain and returns the
-    replica with the longest contiguous-from-root coverage, which is the
-    only kind of coverage a splice can use."""
+    from allocator listeners — mapped to the replicas holding each chain
+    link, each tagged with the TIER the holder keeps it in (0 = HBM,
+    1 = demoted to the replica's host/disk tiers but restorable).
+    ``best_holder`` walks a prompt's chain and returns the replica with the
+    longest contiguous-from-root coverage — the only kind of coverage a
+    splice can use — tie-broken toward the holder whose chain sits lowest
+    in the hierarchy (HBM beats demoted: no restore cost on arrival)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._holders: dict = {}      # chain key -> set of replica names
+        self._holders: dict = {}      # chain key -> {replica name: tier}
         self.hits = 0                 # lookups that found a holder
         self.misses = 0
         self.invalidations = 0        # key-holder pairs dropped by eviction
+        self.demotions = 0            # key-holder pairs marked demoted
 
     # ----------------------------------------------- listener-facing edges
     def publish(self, name: str, key) -> None:
+        # also the promotion edge: a demoted key restored to HBM republishes
+        # through the allocator, which resets the holder's tier tag to 0
         with self._lock:
-            self._holders.setdefault(key, set()).add(name)
+            self._holders.setdefault(key, {})[name] = 0
 
     def evict(self, name: str, key) -> None:
         with self._lock:
             hs = self._holders.get(key)
             if hs is None or name not in hs:
                 return
-            hs.discard(name)
+            del hs[name]
             if not hs:
                 del self._holders[key]
             self.invalidations += 1
+
+    def demote(self, name: str, key) -> None:
+        """The key left ``name``'s HBM for a lower tier: keep the holder —
+        routing a request there still reuses the prefix (the replica
+        restores it at admission) — but tag it so ties prefer HBM."""
+        with self._lock:
+            self._holders.setdefault(key, {})[name] = 1
+            self.demotions += 1
 
     def drop_replica(self, name: str) -> int:
         """Forget every key ``name`` holds (replica reset/removed)."""
@@ -196,7 +220,7 @@ class ClusterPrefixIndex:
             for key in list(self._holders):
                 hs = self._holders[key]
                 if name in hs:
-                    hs.discard(name)
+                    del hs[name]
                     dropped += 1
                     if not hs:
                         del self._holders[key]
@@ -217,6 +241,7 @@ class ClusterPrefixIndex:
         n = max(0, (len(prompt) - 1) // block_size)
         best_n, best = 0, None
         cur: set | None = None
+        cost: dict = {}  # replica -> total tier depth along its chain
         key = None
         with self._lock:
             for i in range(n):
@@ -224,11 +249,17 @@ class ClusterPrefixIndex:
                 hs = self._holders.get(key)
                 if not hs:
                     break
-                live = (hs if cur is None else cur & hs) - exclude
+                live = (set(hs) if cur is None else cur & set(hs)) - exclude
                 if not live:
                     break
                 cur = live
-                best_n, best = i + 1, next(iter(sorted(live)))
+                for nm in live:
+                    cost[nm] = cost.get(nm, 0) + hs[nm]
+                # coverage first, then the cheapest chain (fewest demoted
+                # links = least restore work on arrival), then name for
+                # determinism
+                best_n = i + 1
+                best = min(live, key=lambda nm: (cost.get(nm, 0), nm))
         if best_n:
             self.hits += 1
         else:
@@ -238,8 +269,12 @@ class ClusterPrefixIndex:
     def stats(self) -> dict:
         with self._lock:
             entries = len(self._holders)
-        return {"entries": entries, "hits": self.hits,
-                "misses": self.misses, "invalidations": self.invalidations}
+            demoted = sum(1 for hs in self._holders.values()
+                          for t in hs.values() if t > 0)
+        return {"entries": entries, "demoted_entries": demoted,
+                "hits": self.hits, "misses": self.misses,
+                "invalidations": self.invalidations,
+                "demotions": self.demotions}
 
 
 @dataclass
@@ -647,6 +682,9 @@ class ServingCluster:
 
     def drain(self, timeout: float | None = None) -> bool:
         return self.router.drain(timeout)
+
+    def tier_stats(self) -> dict:
+        return self.router.tier_stats()
 
     def refresh_metrics(self) -> None:
         self.router.refresh_metrics()

@@ -290,6 +290,32 @@ class EngineLoop:
         except Exception:  # noqa: BLE001 - advisory: racing a mutation is fine
             return 0
 
+    def prefetch_prefix(self, prompt_tokens) -> None:
+        """Advisory tier-prefetch kick: ask the engine's KV tier store to
+        stage any demoted blocks of ``prompt_tokens`` disk→host while the
+        request waits in the queue. Fire-and-forget from the router thread —
+        the method is thread-safe on the engine side (it only touches the
+        tier store's own lock plus racy-safe dict probes), and a missed or
+        stale prefetch costs latency, never correctness."""
+        kick = getattr(self._engine, "tier_prefetch_async", None)
+        if kick is None:
+            return
+        try:
+            kick(prompt_tokens)
+        except Exception:  # noqa: BLE001 - advisory: never fail a submit
+            pass
+
+    def kv_tier_stats(self):
+        """Tier-store counters/bytes for this replica, or None when tiering
+        is off. Advisory cross-thread read (plain ints + dict builds)."""
+        probe = getattr(self._engine, "kv_tier_stats", None)
+        if probe is None:
+            return None
+        try:
+            return probe()
+        except Exception:  # noqa: BLE001 - advisory
+            return None
+
     # --------------------------------- cross-thread engine calls (cluster)
     def call(self, fn, timeout: float | None = 30.0):
         """Run ``fn(engine)`` on the loop thread and return its result.

@@ -189,10 +189,17 @@ def _make_handler(frontend: ServingFrontend):
                     200, get_telemetry().export_chrome_trace(trace_id))
             elif path == "/debug/memory":
                 led = get_telemetry().memledger
-                if led is None:
-                    self._send_json(200, {"enabled": False})
-                else:
-                    self._send_json(200, led.debug_payload())
+                payload = ({"enabled": False} if led is None
+                           else led.debug_payload())
+                tiers = getattr(router, "tier_stats", None)
+                if tiers is not None:
+                    # per-replica KV tier rows (host/disk bytes, demotion/
+                    # promotion/prefetch counters) ride along so operators
+                    # see where off-device KV bytes live
+                    t = tiers()
+                    if t:
+                        payload["kv_tiers"] = t
+                self._send_json(200, payload)
             elif path == "/debug/profile":
                 # bounded device-timeline capture over ~N engine-loop steps
                 # (telemetry/devprof.py); one capture at a time per process

@@ -299,6 +299,13 @@ class ReplicaRouter:
                     f"max_queue_tokens={self.cfg.max_queue_tokens}",
                     retry_after_s=self.cfg.retry_after_s)
             replica = replicas[idx]
+            # prefetch-on-admission: let the chosen replica's KV tier store
+            # stage demoted prefix blocks disk→host while the request sits
+            # in its inbox — by admission the restore either completed (tier
+            # hit) or is abandoned; the splice is token-identical either way
+            kick = getattr(replica, "prefetch_prefix", None)
+            if kick is not None:
+                kick(req.prompt)
             # record the placement-time prefix credit on the request so the
             # engine can re-validate the actual splice at admission (the
             # probe is advisory — LRU eviction between placement and
@@ -417,6 +424,20 @@ class ReplicaRouter:
                 "degraded_mode": s.degraded, "crashes": s.crashes,
                 "respawns": s.respawns,
             })
+        return out
+
+    def tier_stats(self) -> dict:
+        """Per-replica KV tier-store stats (counters, per-tier bytes/blocks)
+        for /debug/memory. Replicas without tiering are omitted; empty dict
+        when no replica has a tier store."""
+        out = {}
+        for r in self._snapshot()[0]:
+            probe = getattr(r, "kv_tier_stats", None)
+            if probe is None:
+                continue
+            s = probe()
+            if s:
+                out[r.name] = s
         return out
 
     def begin_drain(self) -> None:

@@ -66,6 +66,8 @@ OWNERS = (
     "staging_buffers",         # H2D staging + checkpoint host snapshots
     "kv_handoff",              # parked KV blocks awaiting disagg export
     "spec_lanes",              # speculative-decode history/draft state
+    "host_kv_tier",            # demoted KV blocks in the host-RAM arena
+    "disk_kv_tier",            # demoted KV blocks spilled to disk
 )
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "OUT_OF_MEMORY")
@@ -167,7 +169,8 @@ class MemoryLedger:
                 pass  # double release is harmless
 
     def register_provider(self, owner: str, name: str, fn,
-                          carveout_of: str | None = None) -> None:
+                          carveout_of: str | None = None,
+                          offdevice: bool = False) -> None:
         """Attribute a *derived* byte count: ``fn()`` is read at every gauge
         refresh / census / breakdown. A provider returning None is pruned
         (the weakref-holding idiom: closures over ``weakref.ref(engine)``
@@ -180,13 +183,19 @@ class MemoryLedger:
         from the parent, so the attributed total counts each real byte
         exactly once — double-counting would inflate ``attributed_bytes``
         past the census and shrink the unattributed leak signal the census
-        exists to catch."""
+        exists to catch.
+
+        ``offdevice`` marks bytes that do NOT live in device memory (the
+        host-RAM/disk KV tiers): they appear in the breakdown and the
+        ``memory_bytes{owner=}`` gauges, but the census reconciliation
+        against ``jax.live_arrays()`` excludes them — host bytes counted
+        against a device census would read as phantom overattribution."""
         if owner not in OWNERS:
             raise ValueError(f"unknown memory owner {owner!r}")
         if carveout_of is not None and carveout_of not in OWNERS:
             raise ValueError(f"unknown carveout parent {carveout_of!r}")
         with self._lock:
-            self._providers.append([owner, name, fn, carveout_of])
+            self._providers.append([owner, name, fn, carveout_of, offdevice])
 
     # ------------------------------------------------------------ programs
     def note_program(self, key, compiled) -> dict | None:
@@ -232,11 +241,14 @@ class MemoryLedger:
             return {k: dict(v) for k, v in self._programs.items()}
 
     # ----------------------------------------------------------- breakdown
-    def owner_bytes(self) -> dict:
+    def owner_bytes(self, *, device_only: bool = False) -> dict:
         """``{owner: attributed_bytes}`` over every live handle + provider
         (all owners present, zero-filled, so dashboards never miss series).
         Carve-out providers move bytes out of their parent owner rather
-        than adding new ones, so the dict sums to each real byte once."""
+        than adding new ones, so the dict sums to each real byte once.
+        ``device_only=True`` skips off-device providers (host/disk KV
+        tiers) — the census reconciles that variant against the device's
+        live arrays."""
         out = {o: 0 for o in OWNERS}
         with self._lock:
             handles = list(self._handles)
@@ -245,6 +257,8 @@ class MemoryLedger:
             out[h.owner] += h.nbytes
         dead = []
         for p in providers:
+            if device_only and p[4]:
+                continue
             try:
                 v = p[2]()
             except Exception:
@@ -280,8 +294,9 @@ class MemoryLedger:
             ]
             providers = [
                 {"owner": o, "name": n,
-                 **({"carveout_of": c} if c else {})}
-                for o, n, _, c in self._providers
+                 **({"carveout_of": c} if c else {}),
+                 **({"offdevice": True} if d else {})}
+                for o, n, _, c, d in self._providers
             ]
         return {
             "owners": owners,
@@ -314,7 +329,11 @@ class MemoryLedger:
             except Exception:
                 continue
         owners = self.owner_bytes()
-        attributed = sum(owners.values())
+        # reconcile DEVICE bytes only: the host-RAM/disk KV tiers are real
+        # attributed bytes for the breakdown, but they are invisible to
+        # jax.live_arrays() and would read as phantom overattribution here
+        attributed = sum(self.owner_bytes(device_only=True).values())
+        offdevice = max(0, sum(owners.values()) - attributed)
         unattributed = max(0, live_bytes - attributed)
         # attribution exceeding the census means stale handles (e.g. a
         # donated buffer whose handle was never updated) — its own smell
@@ -335,6 +354,7 @@ class MemoryLedger:
             "live_bytes": live_bytes,
             "live_arrays": live_count,
             "attributed_bytes": attributed,
+            "offdevice_bytes": offdevice,
             "unattributed_bytes": unattributed,
             "overattributed_bytes": overattributed,
             "unattributed_fraction": round(frac, 6),

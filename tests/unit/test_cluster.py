@@ -104,6 +104,29 @@ class TestTransferCostModel:
         assert not transfer_beats_prefill(64, bytes_per_token=1 << 20,
                                           cfg=cfg)
 
+    def test_zero_length_prefix_never_transfers(self):
+        cfg = ClusterConfig(transfer_gbps=100.0, prefill_tokens_per_s=1000.0)
+        assert not transfer_beats_prefill(0, bytes_per_token=1024, cfg=cfg)
+        assert not transfer_beats_prefill(-3, bytes_per_token=1024, cfg=cfg)
+
+    def test_exact_cost_tie_prefers_prefill(self):
+        # wire: 125_000 B/token * 8 b/B / 1 Gb/s = 1 ms/token;
+        # prefill: 1000 tok/s = 1 ms/token — a dead tie must NOT transfer
+        # (strict <: the local prefill avoids the channel's failure modes)
+        cfg = ClusterConfig(transfer_gbps=1.0, prefill_tokens_per_s=1000.0)
+        assert not transfer_beats_prefill(64, bytes_per_token=125_000,
+                                          cfg=cfg)
+        # one byte under the tie flips it
+        assert transfer_beats_prefill(64, bytes_per_token=124_999, cfg=cfg)
+
+    def test_unknown_bandwidth_is_conservative(self):
+        # an unreported (-1) bandwidth or prefill rate would go negative in
+        # the divisor and claim a free wire — both must mean "no transfer"
+        cfg = ClusterConfig(transfer_gbps=-1.0, prefill_tokens_per_s=1000.0)
+        assert not transfer_beats_prefill(64, bytes_per_token=16, cfg=cfg)
+        cfg = ClusterConfig(transfer_gbps=100.0, prefill_tokens_per_s=-1.0)
+        assert not transfer_beats_prefill(64, bytes_per_token=16, cfg=cfg)
+
 
 # ----------------------------------------------------- cluster prefix index
 def _chain(tokens):
@@ -170,6 +193,56 @@ class TestClusterPrefixIndex:
         assert idx.best_holder(SHARED + [1], BS) == (4, "r0")
         lst.on_reset()
         assert idx.stats()["entries"] == 0
+
+
+class TestTierAwareIndex:
+    """Demotion keeps the holder (the replica can restore from its tiers)
+    but tags the entry so placement ties prefer blocks still in HBM."""
+
+    def test_demote_keeps_holder_routable(self):
+        idx = ClusterPrefixIndex()
+        k1, k2 = _chain(SHARED)
+        lst = idx.listener_for("A")
+        lst.on_publish(k1)
+        lst.on_publish(k2)
+        lst.on_demote(k2)
+        # still full coverage: a request routed to A restores k2 at
+        # admission — unlike on_evict, which would cap the match at 4
+        assert idx.best_holder(SHARED + [1], BS) == (8, "A")
+        s = idx.stats()
+        assert s["demoted_entries"] == 1 and s["demotions"] == 1
+        assert s["invalidations"] == 0
+
+    def test_tie_prefers_hbm_holder(self):
+        idx = ClusterPrefixIndex()
+        k1, k2 = _chain(SHARED)
+        for name in ("A", "B"):
+            idx.publish(name, k1)
+            idx.publish(name, k2)
+        # equal coverage; A's chain is part-demoted -> B wins despite the
+        # name tie-break preferring "A"
+        idx.demote("A", k1)
+        assert idx.best_holder(SHARED + [1], BS) == (8, "B")
+
+    def test_republish_is_the_promotion_edge(self):
+        idx = ClusterPrefixIndex()
+        k1, k2 = _chain(SHARED)
+        for name in ("A", "B"):
+            idx.publish(name, k1)
+            idx.publish(name, k2)
+        idx.demote("A", k1)
+        idx.publish("A", k1)  # restored to HBM: republish resets the tag
+        assert idx.best_holder(SHARED + [1], BS) == (8, "A")
+        assert idx.stats()["demoted_entries"] == 0
+
+    def test_demoted_entry_still_evictable(self):
+        idx = ClusterPrefixIndex()
+        k1, _ = _chain(SHARED)
+        idx.publish("A", k1)
+        idx.demote("A", k1)
+        idx.evict("A", k1)  # the tiers dropped it too (disk budget/clear)
+        assert idx.stats()["entries"] == 0
+        assert idx.best_holder(SHARED + [1], BS) == (0, None)
 
 
 # ------------------------------------------------------ role-aware placement

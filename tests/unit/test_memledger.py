@@ -118,6 +118,29 @@ class TestLedgerAccounting:
         led.owner_bytes()
         assert len(calls) == 2  # pruned providers are not called again
 
+    def test_offdevice_provider_excluded_from_census(self, tmp_path):
+        """Host/disk KV-tier bytes are real and shown in the breakdown but
+        invisible to jax.live_arrays() — the census must reconcile against
+        device-resident attribution only, or tiering-on would read as
+        over-attribution and trip the drift alarm."""
+        led = _ledger(tmp_path)
+        led.register("kv_pool", "test/pool", 1000)
+        led.register_provider("host_kv_tier", "test/host_arena",
+                              lambda: 700, offdevice=True)
+        led.register_provider("disk_kv_tier", "test/disk_spill",
+                              lambda: 300, offdevice=True)
+        owners = led.owner_bytes()
+        assert owners["host_kv_tier"] == 700
+        assert owners["disk_kv_tier"] == 300
+        assert led.owner_bytes(device_only=True)["host_kv_tier"] == 0
+        rows = {r["name"]: r for r in led.breakdown()["providers"]}
+        assert rows["test/host_arena"]["offdevice"] is True
+        c = led.census(update_state=False)
+        # attributed (device) = 1000; the 1000 off-device bytes ride in
+        # their own column instead of skewing unattributed_fraction
+        assert c["attributed_bytes"] == 1000
+        assert c["offdevice_bytes"] == 1000
+
     def test_carveout_provider_moves_bytes_not_adds(self, tmp_path):
         """prefix-LRU / handoff bytes live INSIDE the kv_pool arrays: a
         carve-out re-attributes them without double-counting, so the
