@@ -17,6 +17,7 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 import time
@@ -1490,6 +1491,285 @@ def run_disagg_subprocess(timeout: float = 900.0):
     return _run_flagged_subprocess("BENCH_SERVING_DISAGG", timeout)
 
 
+def fleet_worker_main():
+    """Grandchild process: ONE fleet worker (``BENCH_FLEET_WORKER`` =
+    prefill|decode) in the 2-process ``--mode fleet`` topology.
+
+    Both roles configure telemetry with tracing + a FleetReporter, write a
+    liveness beacon, run their half of a disaggregated request, then flush
+    metric snapshot + trace spill into the shared fleet dir. The prefill
+    worker exports the KVHandoff (traceparent stamped) to a file; the
+    decode worker imports it, finishes the decode under the SAME trace,
+    then serves the rollup HTTP surface (``/debug/fleet``,
+    ``/metrics/fleet``, ``/healthz``) and probes it. One JSON line out.
+    """
+    import http.client
+
+    import numpy as np
+    import jax
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.elasticity.agent import publish_heartbeat_ages
+    from deepspeed_tpu.inference.ragged import (
+        KVHandoff, RaggedConfig, RaggedInferenceEngine)
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.serving import (
+        EngineLoop, ReplicaRouter, ServingFrontend)
+
+    e = os.environ
+    role = e["BENCH_FLEET_WORKER"]
+    fleet_dir = e["BENCH_FLEET_DIR"]
+    hb_dir = e["BENCH_FLEET_HEARTBEATS"]
+    handoff_path = e["BENCH_FLEET_HANDOFF"]
+    rank = 0 if role == "prefill" else 1
+    worker = f"{role}-0"
+
+    telemetry.configure(
+        enabled=True, tracing=True,
+        slo={"enabled": True, "replica": worker},
+        fleet={"enabled": True, "dir": fleet_dir, "worker": worker,
+               "labels": {"role": role}})
+    tel = telemetry.TELEMETRY
+    tracer = tel.tracer
+
+    # tiny model on every backend: this leg measures the observability
+    # plane (federation + stitching), not model throughput
+    model_cfg = llama.LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=688,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256)
+    max_new, max_prompt, block, max_seqs = 6, 16, 8, 3
+    mbs = -(-(max_prompt + max_new) // block)
+    rcfg = RaggedConfig(
+        max_tokens_per_step=64, max_seqs=max_seqs, block_size=block,
+        num_blocks=max_seqs * mbs + 1, max_blocks_per_seq=mbs,
+        enable_prefix_cache=True)
+    # seed=0 on both sides -> identical params, a genuine resume
+    eng = RaggedInferenceEngine(
+        model=lambda ctx: llama.build(model_cfg, ctx=ctx),
+        ragged_config=rcfg, seed=0)
+
+    # liveness beacon (sentinel heartbeat protocol), then surface beacon
+    # ages as gauges so they federate; the sleep keeps the youngest age
+    # strictly nonzero for the CI assert
+    with open(os.path.join(hb_dir, f"heartbeat_{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "role": role, "pid": os.getpid()}, f)
+    time.sleep(0.06)
+
+    out = {"worker": worker, "role": role, "pid": os.getpid(),
+           "backend": jax.default_backend()}
+    uid = "fleet-req"
+    t0 = time.perf_counter()
+    if role == "prefill":
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(1, model_cfg.vocab_size,
+                              (12,), dtype=np.int32).tolist()
+        root = tracer.extract(None)
+        eng.put(uid, prompt, max_new_tokens=max_new, handoff=True,
+                trace=root)
+        while uid not in eng.finished_uids:
+            eng.step()
+        rec = eng.export_handoff(uid)
+        if rec is None or rec.traceparent is None:
+            raise RuntimeError("prefill produced no traced handoff")
+        buf = rec.to_bytes()
+        with open(handoff_path + ".tmp", "wb") as f:
+            f.write(buf)
+        os.replace(handoff_path + ".tmp", handoff_path)
+        tracer.finish(root, "fleet/request", t0, time.perf_counter(),
+                      role=role, uid=uid)
+        out.update(trace_id=root.trace_id, handoff_bytes=len(buf),
+                   wall_s=round(time.perf_counter() - t0, 3))
+    else:
+        with open(handoff_path, "rb") as f:
+            rec = KVHandoff.from_bytes(f.read())
+        if not eng.import_handoff(rec):
+            raise RuntimeError("decode replica could not adopt the handoff")
+        while rec.uid not in eng.finished_uids:
+            eng.step()
+        gen = list(eng.get_request(rec.uid).generated)
+        out.update(trace_id=(rec.traceparent or "--").split("-")[1],
+                   generated_tokens=len(gen), resumed_from_pos=rec.pos,
+                   wall_s=round(time.perf_counter() - t0, 3))
+
+    publish_heartbeat_ages(hb_dir, telemetry=tel)
+    tel.fleet.flush()  # metrics snapshot + trace spill, atomically
+
+    if role == "decode":
+        # both workers' snapshots are on disk now (prefill ran first):
+        # serve the rollup surface off a cold replica router and probe it
+        frontend = ServingFrontend(
+            ReplicaRouter([EngineLoop(eng, name=worker, role="decode")]),
+            fleet_dir=fleet_dir).start()
+
+        def get(path: str) -> tuple[int, dict | str]:
+            conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                              timeout=60)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read().decode("utf-8", "replace")
+            conn.close()
+            ctype = resp.getheader("Content-Type") or ""
+            return resp.status, (json.loads(body)
+                                 if "json" in ctype else body)
+        try:
+            st_d, debug = get("/debug/fleet")
+            st_m, prom = get("/metrics/fleet")
+            st_h, health = get("/healthz")
+        finally:
+            frontend.close()
+        import re
+        out.update(
+            http_debug_fleet={
+                "status": st_d,
+                "workers": len(debug.get("workers", []))
+                if isinstance(debug, dict) else 0,
+                "verdict": (debug.get("health") or {}).get("verdict")
+                if isinstance(debug, dict) else None,
+                "heartbeat_ages": debug.get("heartbeat_ages")
+                if isinstance(debug, dict) else None,
+            },
+            http_metrics_fleet={
+                "status": st_m,
+                "worker_labels": sorted(set(
+                    re.findall(r'worker="([^"]+)"', prom)))
+                if isinstance(prom, str) else [],
+            },
+            http_healthz={
+                "status": st_h,
+                "state": health.get("status")
+                if isinstance(health, dict) else None,
+                "fleet": health.get("fleet")
+                if isinstance(health, dict) else None,
+            })
+
+    telemetry.TELEMETRY.close()
+    print(json.dumps(out))
+    return 0
+
+
+def fleet_bench_main():
+    """Child process: the 2-process fleet observability trial
+    (``--mode fleet``, docs/OBSERVABILITY.md).
+
+    Spawns a prefill worker and a decode worker as SEPARATE processes
+    sharing only a fleet dir, a heartbeat dir, and a KVHandoff file, then
+    verifies the fleet plane end to end: a single stitched trace_id whose
+    spans come from both worker pids in the merged Perfetto export, a
+    federated scrape carrying >= 2 distinct ``worker=`` label values, and
+    nonzero heartbeat-age gauges. One JSON line out.
+    """
+    import re
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.elasticity.agent import (
+        beacon_ages, publish_heartbeat_ages)
+    from deepspeed_tpu.telemetry.fleet import (
+        FleetAggregator, merge_fleet_traces)
+
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "runs", "BENCH_fleet")
+    shutil.rmtree(base, ignore_errors=True)
+    fleet_dir = os.path.join(base, "fleet")
+    hb_dir = os.path.join(base, "heartbeats")
+    os.makedirs(fleet_dir, exist_ok=True)
+    os.makedirs(hb_dir, exist_ok=True)
+    handoff_path = os.path.join(base, "handoff.bin")
+
+    def run_worker(role: str) -> dict:
+        env = dict(os.environ)
+        env.pop("BENCH_FLEET", None)  # a worker must never recurse
+        env["BENCH_FLEET_WORKER"] = role
+        env["BENCH_FLEET_DIR"] = fleet_dir
+        env["BENCH_FLEET_HEARTBEATS"] = hb_dir
+        env["BENCH_FLEET_HANDOFF"] = handoff_path
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{role} worker exited {proc.returncode}:\n"
+                + proc.stderr[-2000:])
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError(f"no JSON from {role} worker:\n"
+                           + proc.stdout[-2000:])
+
+    error = None
+    workers = {}
+    try:
+        workers["prefill"] = run_worker("prefill")
+        workers["decode"] = run_worker("decode")
+    except Exception as ex:  # noqa: BLE001 - bench child must emit JSON
+        error = f"{type(ex).__name__}: {ex}"
+
+    # offline rollup in the parent: aggregate the dir both workers fed
+    telemetry.configure(enabled=True)
+    agg = FleetAggregator(fleet_dir, ttl_s=300.0,
+                          registry=telemetry.TELEMETRY.registry)
+    debug = agg.debug_payload()
+    prom = agg.render_prometheus()
+    fed_workers = sorted(set(re.findall(r'worker="([^"]+)"', prom)))
+
+    merged = merge_fleet_traces(fleet_dir)
+    tids = merged["otherData"]["trace_ids"]
+    want_tid = workers.get("prefill", {}).get("trace_id")
+    stitched_pids = {ev["pid"] for ev in merged["traceEvents"]
+                     if ev.get("ph") == "X"
+                     and ev["args"].get("trace_id") == want_tid}
+    trace_path = os.path.join(base, "fleet_trace.json")
+    with open(trace_path, "w") as f:
+        json.dump(merged, f)
+
+    ages = beacon_ages(hb_dir)
+    publish_heartbeat_ages(hb_dir, telemetry=telemetry.TELEMETRY)
+
+    same_tid = (want_tid is not None
+                and workers.get("decode", {}).get("trace_id") == want_tid)
+    stitched_ok = bool(same_tid and len(stitched_pids) >= 2
+                       and tids == [want_tid])
+    federated_ok = len(fed_workers) >= 2
+    heartbeat_ok = (len(ages) >= 2
+                    and all(a > 0.0 for a in ages.values()))
+    http_ok = all(
+        v.get("status") == 200 for v in (
+            workers.get("decode", {}).get("http_debug_fleet", {}),
+            workers.get("decode", {}).get("http_metrics_fleet", {}),
+            workers.get("decode", {}).get("http_healthz", {})))
+    fleet_ok = bool(error is None and stitched_ok and federated_ok
+                    and heartbeat_ok and http_ok
+                    and len(debug["workers"]) >= 2)
+    telemetry.TELEMETRY.close()
+    print(json.dumps({
+        "metric": "fleet_observability",
+        "error": error,
+        "fleet_ok": fleet_ok,
+        "stitched_trace_id": want_tid,
+        "stitched_trace_ids_total": len(tids),
+        "stitched_span_pids": sorted(stitched_pids),
+        "stitched_spans": merged["otherData"]["spans"],
+        "stitched_ok": stitched_ok,
+        "trace_workers": merged["otherData"]["workers"],
+        "trace_path": trace_path,
+        "federated_worker_labels": fed_workers,
+        "federated_ok": federated_ok,
+        "debug_workers": len(debug["workers"]),
+        "fleet_health": debug["health"]["verdict"],
+        "fleet_health_reasons": debug["health"]["reasons"],
+        "heartbeat_ages_s": {str(r): round(a, 3)
+                             for r, a in sorted(ages.items())},
+        "heartbeat_ok": heartbeat_ok,
+        "http_ok": http_ok,
+        "workers": workers,
+    }))
+    return 0 if fleet_ok else 1
+
+
+def run_fleet_subprocess(timeout: float = 900.0):
+    return _run_flagged_subprocess("BENCH_FLEET", timeout)
+
+
 def chaos_bench_main():
     try:
         return _chaos_bench_impl()
@@ -2675,10 +2955,19 @@ def main():
                 return 1
             print(json.dumps(result))
             return 0 if result.get("pipeline_ok") else 1
+        if mode == ["fleet"]:
+            result, err = run_fleet_subprocess()
+            if result is None:
+                print(f"fleet bench failed:\n{_err_text(err)}",
+                      file=sys.stderr)
+                _fail_json(err)
+                return 1
+            print(json.dumps(result))
+            return 0 if result.get("fleet_ok") else 1
         if mode != ["serving"]:
             print(f"bench: unknown --mode {mode or '(missing)'}; "
                   "supported: serving, decode-steady, chaos, train-anatomy, "
-                  "train-chaos, pipeline",
+                  "train-chaos, pipeline, fleet",
                   file=sys.stderr)
             return 2
         if "--disagg" in sys.argv:
@@ -2734,6 +3023,16 @@ def main():
         # no jit cache: per-stage programs are tiny and the parity verdict
         # must not hinge on a cache-deserialized fused baseline
         return pipeline_bench_main()
+    if os.environ.get("BENCH_FLEET_WORKER"):
+        # checked before BENCH_FLEET for the same reason as the train-chaos
+        # worker: the orchestrator flag leaks into worker environments and
+        # a fleet worker must never recurse into orchestration
+        _enable_jit_cache()
+        return fleet_worker_main()
+    if os.environ.get("BENCH_FLEET"):
+        # the orchestrator itself never touches jax; workers enable the
+        # jit cache so the second worker reuses the first's programs
+        return fleet_bench_main()
     if os.environ.get("BENCH_SERVING_DISAGG"):
         _enable_jit_cache()
         return disagg_bench_main()

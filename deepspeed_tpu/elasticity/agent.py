@@ -35,6 +35,51 @@ from deepspeed_tpu.elasticity.elasticity import get_compatible_world_sizes
 from deepspeed_tpu.utils.logging import log_dist
 
 
+def beacon_ages(heartbeat_dir: str | None,
+                now: float | None = None) -> dict[int, float]:
+    """Per-rank heartbeat beacon ages (seconds since the freshest write),
+    taking the WORST of the rank beacon and any per-stage beacons
+    (``heartbeat_{rank}_s{t}.json``) — the same staleness verdict the
+    agent's kill decision uses. Ranks with no beacon yet are absent."""
+    ages: dict[int, float] = {}
+    if not heartbeat_dir or not os.path.isdir(heartbeat_dir):
+        return ages
+    wall = time.time() if now is None else float(now)
+    for path in glob.glob(os.path.join(heartbeat_dir, "heartbeat_*.json")):
+        stem = os.path.basename(path)[len("heartbeat_"):-len(".json")]
+        try:
+            rank = int(stem.split("_s")[0])
+        except ValueError:
+            continue
+        try:
+            age = wall - os.path.getmtime(path)
+        except OSError:
+            continue  # beacon swept between glob and stat
+        if rank not in ages or age > ages[rank]:
+            ages[rank] = age
+    return ages
+
+
+def publish_heartbeat_ages(heartbeat_dir: str | None,
+                           telemetry=None) -> dict[int, float]:
+    """Surface beacon ages as ``worker_heartbeat_age_seconds{rank=}``
+    gauges (no-op while telemetry is disabled) and return them. The fleet
+    aggregator's ``/debug/fleet`` rollup reads these series."""
+    if telemetry is None:
+        from deepspeed_tpu.telemetry import get_telemetry
+
+        telemetry = get_telemetry()
+    ages = beacon_ages(heartbeat_dir)
+    if telemetry.enabled and ages:
+        g = telemetry.gauge(
+            "worker_heartbeat_age_seconds",
+            "seconds since each worker rank's freshest heartbeat beacon "
+            "(worst of the rank and per-stage beacons)")
+        for rank, age in ages.items():
+            g.set(age, rank=rank)
+    return ages
+
+
 @dataclass
 class WorkerSpec:
     """One supervised worker process."""
@@ -169,6 +214,12 @@ class ElasticAgent:
                 stale.append(rank)
         return stale
 
+    def heartbeat_ages(self) -> dict[int, float]:
+        """Current per-rank beacon ages, published as
+        ``worker_heartbeat_age_seconds{rank=}`` gauges (the fleet rollup's
+        liveness input). Empty when no heartbeat_dir is configured."""
+        return publish_heartbeat_ages(self.heartbeat_dir)
+
     def run(self) -> int:
         """Supervision loop (reference ``_invoke_run:127``): launch at the
         largest admissible world size; on any worker death — a nonzero exit,
@@ -186,6 +237,8 @@ class ElasticAgent:
         self._launch(world)
         while True:
             time.sleep(self.poll_interval)
+            if self.heartbeat_dir:
+                self.heartbeat_ages()
             for rank in self._stale_workers():
                 # wedged-but-alive: poll() sees nothing wrong, the beacon
                 # does. SIGKILL (a stuck device program ignores SIGTERM)
@@ -219,6 +272,17 @@ class ElasticAgent:
                     except subprocess.TimeoutExpired:
                         w.proc.kill()
                 self.restarts += 1
+                from deepspeed_tpu.telemetry import get_telemetry
+
+                tel = get_telemetry()
+                if tel.enabled:
+                    tel.counter(
+                        "elastic_restarts_total",
+                        "world restarts the elastic agent performed").inc()
+                    tel.gauge(
+                        "elastic_world_size",
+                        "worker count of the supervised world"
+                    ).set(self.world_size)
                 if self.restarts > self.max_restarts:
                     log_dist("elastic agent: restart budget exhausted", ranks=[0])
                     return 1

@@ -47,6 +47,7 @@ from deepspeed_tpu.serving.faults import (
 )
 from deepspeed_tpu.telemetry import get_telemetry
 from deepspeed_tpu.telemetry.memledger import is_resource_exhausted, record_oom
+from deepspeed_tpu.telemetry.tracing import format_traceparent
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -470,6 +471,9 @@ class KVHandoff:
     # (tok, pos, seed, prompt_len, top_k) + float plane (temperature, top_p)
     row_iv: np.ndarray = None
     row_fv: np.ndarray = None
+    # W3C trace context of the originating request, so the decode replica
+    # parents its spans under the same trace_id (fleet trace stitching)
+    traceparent: str | None = None
 
     @property
     def n_blocks(self) -> int:
@@ -525,6 +529,8 @@ class PrefixPayload:
 
     tokens: list[int]        # the covered block-aligned prompt prefix
     block_payload: Any = None  # cache pytree, leaves [L, n_blocks, bs, ...]
+    # trace context of the exporting request (cross-replica span links)
+    traceparent: str | None = None
 
     @property
     def n_blocks(self) -> int:
@@ -1295,7 +1301,9 @@ class RaggedInferenceEngine:
             eos_token_id=seq.eos_token_id, temperature=seq.temperature,
             top_k=seq.top_k, top_p=seq.top_p, seed=seq.seed,
             deadline_remaining_s=rem, block_payload=payload,
-            row_iv=iv, row_fv=fv)
+            row_iv=iv, row_fv=fv,
+            traceparent=(format_traceparent(seq.trace)
+                         if seq.trace is not None else None))
         if self.cfg.enable_prefix_cache:
             self._publish_prompt_blocks(seq)
         self.allocator.free(seq.blocks)
@@ -1359,6 +1367,11 @@ class RaggedInferenceEngine:
             if h.deadline_remaining_s else 0.0,
             t_enqueue=time.perf_counter() if self.telemetry.enabled else 0.0,
         )
+        if self._tracer.enabled and h.traceparent:
+            # adopt the prefill replica's trace: this request's decode-side
+            # spans parent under the exporting span, so the fleet-merged
+            # timeline shows ONE trace_id across both replicas
+            seq.trace = self._tracer.extract(h.traceparent)
         self._results.pop(h.uid, None)  # supersede any stale retired record
         blocks = self.allocator.allocate(n_ctx)
         self._scatter_blocks(blocks, h.block_payload)
@@ -1402,11 +1415,13 @@ class RaggedInferenceEngine:
         self._hist_stale[slot] = True
         return True
 
-    def export_prefix(self, prompt_tokens) -> PrefixPayload | None:
+    def export_prefix(self, prompt_tokens, trace=None) -> PrefixPayload | None:
         """Export the longest locally-cached full-block prefix of a prompt
         as a transferable payload (cluster prefix transfer: the holder
         ships published blocks to the replica the router actually picked).
-        None when nothing is cached."""
+        None when nothing is cached. ``trace`` (a TraceContext) stamps the
+        payload's ``traceparent`` so the importer's span links back to the
+        requesting trace across processes."""
         if not self.cfg.enable_prefix_cache:
             return None
         prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
@@ -1433,7 +1448,9 @@ class RaggedInferenceEngine:
             ).inc(len(hit), direction="export")
         return PrefixPayload(
             tokens=prompt[:len(hit) * self.cfg.block_size],
-            block_payload=payload)
+            block_payload=payload,
+            traceparent=(format_traceparent(trace)
+                         if trace is not None else None))
 
     def import_prefix(self, payload: PrefixPayload | None) -> int:
         """Install transferred prefix blocks into the local prefix cache
@@ -1444,6 +1461,8 @@ class RaggedInferenceEngine:
         imports past the unreserved budget are dropped, never forced."""
         if payload is None or not self.cfg.enable_prefix_cache:
             return 0
+        t_imp0 = (time.perf_counter()
+                  if self._tracer.enabled and payload.traceparent else 0.0)
         bs = self.cfg.block_size
         tokens = [int(t) for t in payload.tokens]
         n = min(payload.n_blocks, len(tokens) // bs)
@@ -1479,6 +1498,13 @@ class RaggedInferenceEngine:
             if alloc.lookup(k) is None:
                 break
             m += 1
+        if t_imp0:
+            # span-link back to the exporting request's trace: the import
+            # renders on this replica's track under the exporter's trace_id
+            ctx = self._tracer.extract(payload.traceparent)
+            self._tracer.finish(ctx, "kv/prefix_import", t_imp0,
+                                time.perf_counter(),
+                                blocks=len(missing), tokens=m * bs)
         return m * bs
 
     # --------------------------------- hierarchical KV tiering (kvtier.py)
@@ -1727,7 +1753,11 @@ class RaggedInferenceEngine:
                 uid=str(seq.uid), status=seq.status,
                 prompt_tokens=len(seq.prompt), new_tokens=n_gen,
                 ttft_s=ttft, preemptions=seq.preemptions or None)
-            seq.trace = None  # released: nothing may record under it now
+            if not (seq.handoff and seq.status == "finished"):
+                seq.trace = None  # released: nothing records under it now
+            # a finished prefill-stage seq keeps its context parked with the
+            # KV blocks: export_handoff stamps it as the record's traceparent
+            # so the decode replica's spans stitch under this trace
 
     def _build_step(self) -> Callable:
         fwd = self.spec.ragged_forward_fn

@@ -46,6 +46,7 @@ from deepspeed_tpu.runtime.pipe.schedule import (
     build_schedule, thread_program, validate_schedule)
 from deepspeed_tpu.runtime.pipe.transport import (
     ACT, GRAD, InProcTransport, TransportAborted)
+from deepspeed_tpu.telemetry.tracing import format_traceparent
 from deepspeed_tpu.utils.logging import log_dist
 
 try:
@@ -58,9 +59,11 @@ class _StepCtx:
     """Per-attempt mutable state of one scheduled step."""
 
     __slots__ = ("microbatches", "mults", "accs", "losses", "stash",
-                 "errors", "recv_wait", "busy", "scale", "measure")
+                 "errors", "recv_wait", "busy", "scale", "measure",
+                 "trace_hdr")
 
-    def __init__(self, microbatches, mults, accs, n_stages, scale, measure):
+    def __init__(self, microbatches, mults, accs, n_stages, scale, measure,
+                 trace_hdr=None):
         self.microbatches = microbatches
         self.mults = mults
         self.accs = accs
@@ -71,6 +74,10 @@ class _StepCtx:
         self.busy = [0.0] * n_stages
         self.scale = scale
         self.measure = measure
+        # the step's W3C traceparent (None with tracing off): every
+        # cross-stage send carries it so receivers record the hop as a
+        # span under one step-wide trace_id (fleet trace stitching)
+        self.trace_hdr = trace_hdr
 
 
 class PipeEngine(Engine):
@@ -381,19 +388,19 @@ class PipeEngine(Engine):
             elif v == 0:
                 y = self._timed(thread, ctx, self._fwd_prog(0),
                                 self.stage_params[0], ctx.microbatches[m])
-                tp.send(0, 1, ACT, m, y)
+                tp.send(0, 1, ACT, m, y, traceparent=ctx.trace_hdr)
             else:
                 x, waited = tp.recv(v - 1, v, ACT, m)
                 ctx.recv_wait[thread] += waited
                 ctx.stash[("in", v, m)] = x
                 y = self._timed(thread, ctx, self._fwd_prog(v),
                                 self.stage_params[v], x)
-                tp.send(v, v + 1, ACT, m, y)
+                tp.send(v, v + 1, ACT, m, y, traceparent=ctx.trace_hdr)
         else:  # "B"
             if v == P - 1:
                 # the fused F+B already produced this microbatch's cotangent
                 dx = ctx.stash.pop(("dx", v, m))
-                tp.send(v, v - 1, GRAD, m, dx)
+                tp.send(v, v - 1, GRAD, m, dx, traceparent=ctx.trace_hdr)
             elif v == 0:
                 dy, waited = tp.recv(1, 0, GRAD, m)
                 ctx.recv_wait[thread] += waited
@@ -408,7 +415,7 @@ class PipeEngine(Engine):
                     thread, ctx, self._bwd_prog(v), self.stage_params[v],
                     ctx.accs[v], x, dy)
                 ctx.accs[v] = new_acc
-                tp.send(v, v - 1, GRAD, m, dx)
+                tp.send(v, v - 1, GRAD, m, dx, traceparent=ctx.trace_hdr)
 
     def _stage_thread(self, thread: int, ctx: _StepCtx):
         inj = self._fault_injector
@@ -434,12 +441,16 @@ class PipeEngine(Engine):
         replay is exact). Returns the completed :class:`_StepCtx` + wall."""
         S = self.stage_plan.n_stages
         measure = self.stepscope.enabled
+        tracer = self.telemetry.tracer
         attempts = 0
         while True:
+            step_trace = tracer.extract(None) if tracer.enabled else None
             ctx = _StepCtx(
                 mbs, mults,
                 [self._zero_acc(v) for v in range(self.stage_plan.n_virtual)],
-                S, self.scale_state.scale, measure)
+                S, self.scale_state.scale, measure,
+                trace_hdr=(format_traceparent(step_trace)
+                           if step_trace is not None else None))
             self.transport.reset()
             t0 = time.perf_counter()
             threads = [threading.Thread(
@@ -460,6 +471,9 @@ class PipeEngine(Engine):
                     f"{self.global_steps}")
             wall = time.perf_counter() - t0
             if not ctx.errors:
+                if step_trace is not None:
+                    tracer.finish(step_trace, "pipe/step", t0, t0 + wall,
+                                  step=self.global_steps, stages=S)
                 return ctx, wall
             attempts += 1
             err = next(iter(ctx.errors.values()))

@@ -29,10 +29,30 @@ class TransportAborted(RuntimeError):
     """The step was aborted (peer crashed / shutdown) while blocked in recv."""
 
 
+class _Traced:
+    """Internal envelope wrapping a payload with its W3C ``traceparent``.
+
+    Only allocated when a send actually carries trace context, so the
+    untraced hot path still hands the raw payload through — zero extra
+    allocations with tracing off."""
+
+    __slots__ = ("payload", "traceparent")
+
+    def __init__(self, payload, traceparent):
+        self.payload = payload
+        self.traceparent = traceparent
+
+
 class Transport:
     """Point-to-point tagged channels between virtual stages."""
 
-    def send(self, src: int, dst: int, kind: str, mb: int, payload) -> None:
+    def send(self, src: int, dst: int, kind: str, mb: int, payload,
+             traceparent: str | None = None) -> None:
+        """Hand a payload to the channel. ``traceparent`` optionally
+        carries the sending step's trace context across the seam; a
+        receiver records the hop as a span linked under it (fleet trace
+        stitching — the hop is visible even when stages live in different
+        processes)."""
         raise NotImplementedError
 
     def recv(self, src: int, dst: int, kind: str, mb: int):
@@ -67,9 +87,11 @@ class InProcTransport(Transport):
                 ch = self._chans[tag] = queue.Queue()
             return ch
 
-    def send(self, src, dst, kind, mb, payload):
+    def send(self, src, dst, kind, mb, payload, traceparent=None):
         if self._abort.is_set():
             raise TransportAborted(f"send({kind} {src}->{dst} mb{mb}) after abort")
+        if traceparent is not None:
+            payload = _Traced(payload, traceparent)
         self._chan((src, dst, kind, mb)).put(payload)
 
     def recv(self, src, dst, kind, mb):
@@ -81,9 +103,27 @@ class InProcTransport(Transport):
                     f"recv({kind} {src}->{dst} mb{mb}) aborted")
             try:
                 payload = ch.get(timeout=self._poll)
-                return payload, time.perf_counter() - t0
+                t1 = time.perf_counter()
+                if type(payload) is _Traced:
+                    self._record_hop(payload.traceparent, src, dst, kind,
+                                     mb, t0, t1)
+                    payload = payload.payload
+                return payload, t1 - t0
             except queue.Empty:
                 continue
+
+    @staticmethod
+    def _record_hop(traceparent, src, dst, kind, mb, t0, t1):
+        """Record the cross-stage hop as a span linked under the sender's
+        context (the receive wait IS the hop's visible cost)."""
+        from deepspeed_tpu.telemetry import get_telemetry
+
+        tracer = get_telemetry().tracer
+        if not tracer.enabled:
+            return
+        ctx = tracer.extract(traceparent)
+        tracer.finish(ctx, f"pipe/recv_{kind}", t0, t1,
+                      src=src, dst=dst, mb=mb)
 
     def abort(self):
         self._abort.set()

@@ -444,7 +444,8 @@ class ServingCluster:
             if not transfer_beats_prefill(delta, bpt, self.cfg):
                 return chosen
             payload = holder_loop.call(
-                lambda e: e.export_prefix(req.prompt))
+                lambda e: e.export_prefix(req.prompt,
+                                          trace=req.trace_ctx))
             if payload is None:
                 return chosen
             self.channel.transfer(payload)
@@ -871,10 +872,12 @@ def build_cluster_server(prefill_engines, decode_engines,
                          host: str = "127.0.0.1", port: int = 0,
                          cluster_cfg: ClusterConfig | None = None,
                          router_cfg: RouterConfig | None = None,
-                         start: bool = True):
+                         start: bool = True, fleet_dir: str | None = None):
     """Convenience mirror of ``frontend.build_server`` for a disaggregated
     pool: wrap engines in role-tagged loops, build the cluster, bind the
-    HTTP frontend on it. Returns ``(frontend, cluster, loops)``."""
+    HTTP frontend on it. Returns ``(frontend, cluster, loops)``.
+    ``fleet_dir`` additionally serves the federated ``/metrics/fleet`` +
+    ``/debug/fleet`` rollup over that snapshot directory."""
     from deepspeed_tpu.serving.frontend import ServingFrontend
 
     pre = [EngineLoop(e, name=f"prefill-{i}", role="prefill")
@@ -883,7 +886,8 @@ def build_cluster_server(prefill_engines, decode_engines,
            for i, e in enumerate(decode_engines)]
     cluster = ServingCluster(pre, dec, cfg=cluster_cfg,
                              router_cfg=router_cfg)
-    frontend = ServingFrontend(cluster, host=host, port=port)
+    frontend = ServingFrontend(cluster, host=host, port=port,
+                               fleet_dir=fleet_dir)
     if start:
         for lp in (*pre, *dec):
             lp.start()

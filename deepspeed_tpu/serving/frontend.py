@@ -28,6 +28,14 @@ Endpoints:
   unattributed bytes), per-program temp footprints, device allocator
   stats, and any OOM crash reports written this process. ``{"enabled":
   false}`` when no ledger is configured.
+- ``GET /metrics/fleet`` — federated Prometheus view merged across every
+  worker's fleet snapshot (counters summed, gauges per-worker-labelled,
+  histogram buckets added; see ``telemetry/fleet.py``). 404 until a fleet
+  dir is configured.
+- ``GET /debug/fleet`` — the cluster rollup JSON: per-worker liveness,
+  SLO burn, census drift, circuit-breaker/KV-tier stats, heartbeat ages,
+  and the ``fleet_health`` verdict. A non-ok verdict also degrades
+  ``/healthz`` (fleet-wide burn visible from any one worker's probe).
 
 Tracing: ``POST /v1/completions`` honors an incoming W3C ``traceparent``
 header (or head-samples a fresh trace when the tracer is enabled); the
@@ -73,9 +81,14 @@ class ServingFrontend:
     """Bind + serve the HTTP surface for one ReplicaRouter."""
 
     def __init__(self, router: ReplicaRouter, host: str = "127.0.0.1",
-                 port: int = 0, request_timeout_s: float = 300.0):
+                 port: int = 0, request_timeout_s: float = 300.0,
+                 fleet_dir: str | None = None, fleet_ttl_s: float = 30.0):
         self.router = router
         self.request_timeout_s = float(request_timeout_s)
+        # fleet rollup surface: explicit dir, else the process's configured
+        # FleetReporter's dir (None disables /debug/fleet + /metrics/fleet)
+        self._fleet_dir = fleet_dir
+        self._fleet_ttl_s = float(fleet_ttl_s)
         handler = _make_handler(self)
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True
@@ -97,6 +110,21 @@ class ServingFrontend:
         requests finish and the engine loops exit on their own threads."""
         handler.register("serving-drain", self.router.begin_drain,
                          immediate=True)
+
+    def fleet_aggregator(self):
+        """A :class:`FleetAggregator` over the configured fleet dir, or
+        None when neither the frontend nor the telemetry singleton has
+        fleet reporting configured."""
+        fleet_dir = self._fleet_dir
+        if fleet_dir is None:
+            reporter = get_telemetry().fleet
+            if reporter is None:
+                return None
+            fleet_dir = reporter.out_dir
+        from deepspeed_tpu.telemetry.fleet import FleetAggregator
+
+        return FleetAggregator(fleet_dir, ttl_s=self._fleet_ttl_s,
+                               registry=get_telemetry().registry)
 
     def drain(self, timeout: float | None = None) -> bool:
         """Stop admitting, wait for inflight work, stop the HTTP listener."""
@@ -168,7 +196,38 @@ def _make_handler(frontend: ServingFrontend):
                         # latency is burning error budget — operators and
                         # balancers can deprioritize without ejecting it
                         payload["status"] = "degraded"
+                agg = frontend.fleet_aggregator()
+                if agg is not None:
+                    # fleet-wide rollup: a breach anywhere in the fleet
+                    # (another worker's SLO burn, a dead heartbeat, an open
+                    # breaker) degrades THIS health page, so one probe sees
+                    # cluster trouble without scraping every worker
+                    fleet = agg.debug_payload()
+                    payload["fleet"] = fleet["health"]
+                    if (payload["status"] == "ready"
+                            and fleet["health"]["value"] > 0):
+                        payload["status"] = "degraded"
                 self._send_json(503 if state == "draining" else 200, payload)
+            elif path == "/metrics/fleet":
+                agg = frontend.fleet_aggregator()
+                if agg is None:
+                    self._send_error_json(
+                        404, "no fleet dir configured "
+                        "(telemetry.configure(fleet={...}))")
+                    return
+                body = agg.render_prometheus().encode("utf-8")
+                self._last_code = 200
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 PrometheusExporter.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/debug/fleet":
+                agg = frontend.fleet_aggregator()
+                payload = ({"enabled": False} if agg is None
+                           else agg.debug_payload())
+                self._send_json(200, payload)
             elif path == "/metrics":
                 router.refresh_metrics()
                 tel = get_telemetry()

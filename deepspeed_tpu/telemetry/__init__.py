@@ -30,6 +30,13 @@ from deepspeed_tpu.telemetry.devprof import (  # noqa: F401
     merge_into_ring,
     parse_chrome_trace,
 )
+from deepspeed_tpu.telemetry.fleet import (  # noqa: F401
+    FleetAggregator,
+    FleetReporter,
+    merge_fleet_traces,
+    merge_metric_snapshots,
+    render_federated_prometheus,
+)
 from deepspeed_tpu.telemetry.memledger import (  # noqa: F401
     MemoryLedger,
     OWNERS as MEMORY_OWNERS,
@@ -75,7 +82,10 @@ def dump(path: str) -> dict:
     return TELEMETRY.dump(path)
 
 
-def dump_trace(path: str | None = None, trace_id: str | None = None) -> dict:
+def dump_trace(path: str | None = None, trace_id: str | None = None,
+               fleet=False) -> dict:
     """Export the request-trace span ring as Chrome trace-event JSON
-    (Perfetto-loadable); writes ``path`` when given."""
-    return TELEMETRY.dump_trace(path, trace_id)
+    (Perfetto-loadable); writes ``path`` when given. ``fleet=True`` (or a
+    fleet-dir path) merges every worker's spilled ring into ONE timeline
+    with per-process tracks."""
+    return TELEMETRY.dump_trace(path, trace_id, fleet=fleet)

@@ -50,6 +50,7 @@ class Telemetry:
         self._slo = None
         self._compile_watch = None
         self._memledger = None
+        self._fleet = None
         self._sinks: list = []
         self._prometheus = None
         self._sampler = None
@@ -129,12 +130,27 @@ class Telemetry:
                     ),
                     self.registry,
                     burn_threshold=float(slo.get("burn_threshold", 1.0)),
+                    replica=slo.get("replica"),
                 )
                 self._slo.refresh_gauges()
             if opts.get("compile_metrics", True):
                 from deepspeed_tpu.telemetry.compile_watch import CompileWatch
 
                 self._compile_watch = CompileWatch(self.registry).install()
+            fleet = opts.get("fleet") or {}
+            if fleet is True:
+                fleet = {"enabled": True}
+            if fleet.get("enabled"):
+                from deepspeed_tpu.telemetry.fleet import FleetReporter
+
+                self._fleet = FleetReporter(
+                    self,
+                    out_dir=str(fleet.get("dir", "runs/fleet")),
+                    worker=fleet.get("worker"),
+                    labels=fleet.get("labels"),
+                    interval_s=float(fleet.get("interval_s", 0.0)),
+                    spill_traces=bool(fleet.get("spill_traces", True)),
+                ).start()
             ml = opts.get("memledger") or {}
             if ml is True:
                 ml = {"enabled": True}
@@ -155,7 +171,8 @@ class Telemetry:
                                     if self._prometheus else None),
                    tracing=self.tracer.enabled,
                    slo=self._slo is not None,
-                   memledger=self._memledger is not None)
+                   memledger=self._memledger is not None,
+                   fleet=(self._fleet.worker if self._fleet else None))
         return self
 
     @property
@@ -248,12 +265,43 @@ class Telemetry:
         return self.tracer.export_chrome(trace_id)
 
     def dump_trace(self, path: str | None = None,
-                   trace_id: str | None = None) -> dict:
+                   trace_id: str | None = None, fleet=False) -> dict:
         """Export the span ring as Chrome trace JSON; writes ``path`` when
-        given, returns the trace dict either way."""
+        given, returns the trace dict either way.
+
+        ``fleet=True`` merges every worker's spilled ring from the
+        configured fleet dir (or pass a fleet-dir path as ``fleet``) into
+        ONE timeline with a per-process track per worker — see
+        :func:`deepspeed_tpu.telemetry.fleet.merge_fleet_traces`.
+        """
+        if fleet:
+            from deepspeed_tpu.telemetry.fleet import merge_fleet_traces
+
+            fleet_dir = fleet if isinstance(fleet, str) else (
+                self._fleet.out_dir if self._fleet is not None
+                else "runs/fleet")
+            trace = merge_fleet_traces(fleet_dir, local_tracer=self.tracer,
+                                       trace_id=trace_id)
+            if path is not None:
+                import json
+                import os
+
+                parent = os.path.dirname(os.path.abspath(path))
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                with open(path, "w") as f:
+                    json.dump(trace, f)
+            return trace
         if path is None:
             return self.tracer.export_chrome(trace_id)
         return self.tracer.dump(path, trace_id)
+
+    # ------------------------------------------------------------- fleet
+    @property
+    def fleet(self):
+        """The configured :class:`FleetReporter`, or None (hot paths guard
+        on this one attribute read)."""
+        return self._fleet
 
     # ------------------------------------------------------------- slo
     @property
@@ -338,6 +386,12 @@ class Telemetry:
             self._prometheus = None
         self._sampler = None
         self._memledger = None
+        if self._fleet is not None:
+            try:
+                self._fleet.stop(final_flush=False)
+            except Exception:
+                pass
+            self._fleet = None
         self._since_flush = 0
         self.tracer.reset()
         self._slo = None

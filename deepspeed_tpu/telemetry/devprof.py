@@ -470,7 +470,11 @@ class DeviceProfiler:
             self._count_rejected(tag)
             return False
         self._seq += 1
-        cap_dir = os.path.join(self.out_dir, f"cap-{self._seq:06d}")
+        # pid-scoped dir name: multiple worker processes sharing one
+        # runs/devprof must never collide on cap-{seq} (each process's
+        # sequence starts at 1), and rotation below stays per-worker
+        cap_dir = os.path.join(self.out_dir,
+                               f"cap-{os.getpid()}-{self._seq:06d}")
         try:
             import jax
 
@@ -570,8 +574,12 @@ class DeviceProfiler:
     # -- internals -----------------------------------------------------
 
     def _rotate(self) -> None:
+        # per-worker rotation: only THIS process's captures are eligible —
+        # a sibling worker profiling into the same shared dir must never
+        # have its captures deleted out from under it
         try:
-            caps = sorted(glob.glob(os.path.join(self.out_dir, "cap-*")))
+            caps = sorted(glob.glob(
+                os.path.join(self.out_dir, f"cap-{os.getpid()}-*")))
             for stale in caps[: -self.keep]:
                 shutil.rmtree(stale, ignore_errors=True)
         except OSError:

@@ -1,0 +1,599 @@
+"""Fleet observability plane: metric federation + cross-process trace merge.
+
+Every instrument so far — registry (PR 1), trace ring (PR 5), stepscope
+(PR 7), memledger (PR 11), devprof (PR 13) — is process-local, but the
+system stopped being a process: the disaggregated cluster hands requests
+prefill→decode between replicas, the MPMD pipeline runs per-stage workers,
+and the ElasticAgent supervises a multi-process gang. This module makes the
+*fleet* the unit of observation, in three legs:
+
+**Federation.** Each worker owns a :class:`FleetReporter` that periodically
+snapshots its registry to ``runs/fleet/metrics_{worker}.json`` (atomic
+temp + fsync + rename, the PR 9/15 commit discipline — a reader can never
+see a torn file, only the old or the new snapshot). A
+:class:`FleetAggregator` on any process merges whatever snapshots exist:
+
+- **counters sum** across workers per identical label set (a fleet-total
+  ``serving_requests_admitted_total`` is the sum of every worker's);
+- **gauges keep per-source series** — each gauge series gains a
+  ``worker=<name>`` label (plus the reporter's identity labels, e.g.
+  ``replica=``/``stage=``/``role=``) so last-write-wins values are never
+  averaged into fiction;
+- **histogram buckets add** per label set (cumulative bucket counts, sum
+  and count are all additive).
+
+The merged view renders as Prometheus text (federated ``/metrics``) and as
+the ``GET /debug/fleet`` JSON rollup: per-worker liveness, SLO burn, census
+drift, circuit-breaker and KV-tier stats, heartbeat ages, and one
+``fleet_health`` verdict gauge (0 ok / 1 degraded / 2 critical).
+
+**Trace stitching.** Workers spill their bounded span rings to
+``trace_{worker}.json`` next to the metric snapshots, each stamped with the
+tracer's ``(perf_counter, unix)`` epoch anchor pair.
+:func:`merge_fleet_traces` maps every span's ``perf_counter`` stamp onto
+the shared unix clock via ``epoch_unix + (t0 - epoch_pc)`` (the devprof
+anchor idea, applied across processes) and emits ONE Chrome trace-event
+JSON with a per-process track per worker — a disaggregated request shows
+its prefill-replica and decode-replica spans under a single trace_id on
+one timeline.
+
+**Staleness & crash safety.** Snapshots older than ``ttl_s`` are expired
+from federation (the worker is listed as dead, not silently merged);
+unparseable/torn files are skipped. Reading is pull-only: the aggregator
+never blocks a worker.
+
+Everything here is opt-in (``telemetry.configure(fleet={...})``); with no
+reporter configured the serving/training hot paths allocate nothing — the
+zero-alloc pin in ``tests/unit/test_fleet.py`` holds the disabled path to
+zero allocations from this module.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+
+from deepspeed_tpu.telemetry.registry import (
+    _fmt,
+    _label_key,
+    _render_labels,
+    sanitize_metric_name,
+)
+
+FLEET_SCHEMA = 1
+
+# snapshot file name prefixes inside the fleet dir
+_METRICS_PREFIX = "metrics_"
+_TRACE_PREFIX = "trace_"
+
+# fleet_health verdict encoding (gauge value)
+HEALTH_OK = 0.0
+HEALTH_DEGRADED = 1.0
+HEALTH_CRITICAL = 2.0
+
+_VERDICT_NAMES = {HEALTH_OK: "ok", HEALTH_DEGRADED: "degraded",
+                  HEALTH_CRITICAL: "critical"}
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    """Temp + fsync + rename commit (PR 9 discipline) so a concurrent
+    reader sees the old snapshot or the new one, never a torn file."""
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(obj, f, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str):
+    """One snapshot file, or None when missing/torn/not-a-dict (crash-safe
+    read path: a half-written or corrupt file is skipped, never fatal)."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+def default_worker_name() -> str:
+    return f"w{os.getpid()}"
+
+
+class FleetReporter:
+    """Per-worker publisher: registry snapshots + trace-ring spills into a
+    shared fleet directory. Owned by the Telemetry singleton when
+    ``configure(fleet={...})`` opts in; a worker with no reporter pays
+    nothing."""
+
+    def __init__(self, telemetry, out_dir: str = "runs/fleet",
+                 worker: str | None = None, labels: dict | None = None,
+                 interval_s: float = 0.0, spill_traces: bool = True):
+        self.telemetry = telemetry
+        self.out_dir = str(out_dir)
+        self.worker = sanitize_metric_name(worker) if worker \
+            else default_worker_name()
+        self.labels = {str(k): str(v) for k, v in (labels or {}).items()}
+        self.interval_s = float(interval_s)
+        self.spill_traces = bool(spill_traces)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- publish
+    @property
+    def metrics_path(self) -> str:
+        return os.path.join(self.out_dir, f"{_METRICS_PREFIX}{self.worker}.json")
+
+    @property
+    def trace_path(self) -> str:
+        return os.path.join(self.out_dir, f"{_TRACE_PREFIX}{self.worker}.json")
+
+    def publish(self, now: float | None = None) -> str:
+        """Write one metric snapshot (atomic). Returns the path."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        snap = {
+            "schema": FLEET_SCHEMA,
+            "worker": self.worker,
+            "pid": os.getpid(),
+            "ts": time.time() if now is None else float(now),
+            "seq": seq,
+            "labels": self.labels,
+            "metrics": self.telemetry.registry.snapshot(),
+        }
+        _atomic_write_json(self.metrics_path, snap)
+        return self.metrics_path
+
+    def spill_trace(self) -> str | None:
+        """Write the tracer's ring + epoch anchors (atomic) so another
+        process can stitch this worker's spans onto the fleet clock.
+        Returns the path, or None when the tracer is disabled."""
+        tracer = self.telemetry.tracer
+        if not tracer.enabled:
+            return None
+        state = tracer.spill_state()
+        state.update({
+            "schema": FLEET_SCHEMA,
+            "worker": self.worker,
+            "pid": os.getpid(),
+            "ts": time.time(),
+            "labels": self.labels,
+        })
+        _atomic_write_json(self.trace_path, state)
+        return self.trace_path
+
+    def flush(self) -> None:
+        """Publish metrics + trace spill in one call (bench/test hook and
+        the periodic thread body)."""
+        self.publish()
+        if self.spill_traces:
+            self.spill_trace()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "FleetReporter":
+        """Begin periodic publishing (no-op when ``interval_s <= 0``)."""
+        if self.interval_s <= 0 or self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.flush()
+                except Exception:
+                    pass  # a full disk must never take down the worker
+
+        self._thread = threading.Thread(
+            target=_run, name=f"fleet-reporter-{self.worker}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(5.0)
+            self._thread = None
+        if final_flush:
+            try:
+                self.flush()
+            except Exception:
+                pass
+
+
+# --------------------------------------------------------------- federation
+def merge_metric_snapshots(snapshots: list[dict]) -> dict:
+    """Merge per-worker registry snapshots into one federated view (same
+    shape as ``MetricsRegistry.snapshot()``).
+
+    Rules: counters sum per identical label set; gauges keep per-source
+    series (each gains ``worker=`` + the reporter's identity labels);
+    histogram buckets/sum/count add per label set.
+    """
+    merged: dict[str, dict] = {}
+    for snap in snapshots:
+        worker = str(snap.get("worker", "?"))
+        identity = {"worker": worker}
+        for k, v in (snap.get("labels") or {}).items():
+            identity.setdefault(str(k), str(v))
+        for name, metric in (snap.get("metrics") or {}).items():
+            kind = metric.get("kind", "untyped")
+            slot = merged.setdefault(
+                name, {"kind": kind, "help": metric.get("help", ""),
+                       "_series": {}})
+            if slot["kind"] != kind:
+                # conflicting kinds across workers: first one wins, the
+                # rest are dropped rather than corrupting the exposition
+                continue
+            if not slot["help"] and metric.get("help"):
+                slot["help"] = metric["help"]
+            series = slot["_series"]
+            for s in metric.get("series") or []:
+                labels = dict(s.get("labels") or {})
+                if kind == "gauge":
+                    # per-source series: identity labels only fill gaps so
+                    # an already-labelled worker=/replica= series survives
+                    for k, v in identity.items():
+                        labels.setdefault(k, v)
+                key = _label_key(labels)
+                if kind == "counter":
+                    prev = series.get(key)
+                    val = float(s.get("value", 0.0))
+                    series[key] = {
+                        "labels": labels,
+                        "value": val + (prev["value"] if prev else 0.0)}
+                elif kind == "histogram":
+                    prev = series.get(key)
+                    if prev is None:
+                        series[key] = {
+                            "labels": labels,
+                            "count": int(s.get("count", 0)),
+                            "sum": float(s.get("sum", 0.0)),
+                            "buckets": dict(s.get("buckets") or {}),
+                        }
+                    else:
+                        prev["count"] += int(s.get("count", 0))
+                        prev["sum"] += float(s.get("sum", 0.0))
+                        pb = prev["buckets"]
+                        for le, c in (s.get("buckets") or {}).items():
+                            pb[le] = pb.get(le, 0) + int(c)
+                else:  # gauge / untyped: last writer per (worker, labels)
+                    series[key] = {"labels": labels,
+                                   "value": float(s.get("value", 0.0))}
+    out = {}
+    for name, slot in merged.items():
+        out[name] = {
+            "kind": slot["kind"], "help": slot["help"],
+            "series": [slot["_series"][k]
+                       for k in sorted(slot["_series"].keys())],
+        }
+    return out
+
+
+def _bucket_sort_key(le: str):
+    if le == "+Inf":
+        return (1, 0.0)
+    try:
+        return (0, float(le))
+    except ValueError:
+        return (2, 0.0)
+
+
+def render_federated_prometheus(merged: dict) -> str:
+    """Prometheus text exposition 0.0.4 from a merged snapshot dict."""
+    lines: list[str] = []
+    for name in sorted(merged.keys()):
+        slot = merged[name]
+        mname = sanitize_metric_name(name)
+        if slot.get("help"):
+            lines.append(f"# HELP {mname} {slot['help']}")
+        lines.append(f"# TYPE {mname} {slot.get('kind', 'untyped')}")
+        for s in slot.get("series") or []:
+            key = _label_key(s.get("labels") or {})
+            if slot.get("kind") == "histogram":
+                buckets = s.get("buckets") or {}
+                for le in sorted(buckets.keys(), key=_bucket_sort_key):
+                    le_txt = "+Inf" if le == "+Inf" else _fmt(float(le))
+                    lines.append(
+                        f"{mname}_bucket"
+                        f"{_render_labels(key, (('le', le_txt),))} "
+                        f"{int(buckets[le])}")
+                lines.append(
+                    f"{mname}_sum{_render_labels(key)} {_fmt(s.get('sum', 0.0))}")
+                lines.append(
+                    f"{mname}_count{_render_labels(key)} {int(s.get('count', 0))}")
+            else:
+                lines.append(
+                    f"{mname}{_render_labels(key)} {_fmt(s.get('value', 0.0))}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class FleetAggregator:
+    """Pull-side of federation: reads whatever snapshot files exist under
+    the fleet dir, expires stale ones, merges the rest. Stateless between
+    calls except the ``ttl_s`` policy — safe to construct per scrape."""
+
+    def __init__(self, fleet_dir: str = "runs/fleet", ttl_s: float = 30.0,
+                 registry=None):
+        self.fleet_dir = str(fleet_dir)
+        self.ttl_s = float(ttl_s)
+        # optional local registry the rolled-up fleet_health verdict gauge
+        # is published into (so the verdict itself is scrapeable)
+        self.registry = registry
+
+    # -------------------------------------------------------------- reading
+    def read_snapshots(self, now: float | None = None):
+        """``(fresh, stale)`` lists of per-worker metric snapshots; torn or
+        schema-less files are skipped (crash-safe read path)."""
+        now = time.time() if now is None else float(now)
+        fresh, stale = [], []
+        pattern = os.path.join(self.fleet_dir, f"{_METRICS_PREFIX}*.json")
+        for path in sorted(glob.glob(pattern)):
+            snap = _read_json(path)
+            if (snap is None or snap.get("schema") != FLEET_SCHEMA
+                    or "metrics" not in snap or "worker" not in snap):
+                continue
+            age = now - float(snap.get("ts", 0.0))
+            snap["age_s"] = age
+            (stale if age > self.ttl_s else fresh).append(snap)
+        return fresh, stale
+
+    def merge(self, now: float | None = None) -> dict:
+        fresh, _ = self.read_snapshots(now)
+        return merge_metric_snapshots(fresh)
+
+    def render_prometheus(self, now: float | None = None) -> str:
+        return render_federated_prometheus(self.merge(now))
+
+    # --------------------------------------------------------------- rollup
+    @staticmethod
+    def _series(merged: dict, name: str) -> list[dict]:
+        return (merged.get(name) or {}).get("series") or []
+
+    def debug_payload(self, now: float | None = None) -> dict:
+        """The ``GET /debug/fleet`` body: per-worker liveness + the
+        dimension rollups + one fleet_health verdict."""
+        now = time.time() if now is None else float(now)
+        fresh, stale = self.read_snapshots(now)
+        merged = merge_metric_snapshots(fresh)
+        reasons: list[str] = []
+
+        workers = []
+        roles: dict[str, int] = {}
+        for snap in fresh + stale:
+            live = snap in fresh
+            row = {
+                "worker": snap["worker"],
+                "pid": snap.get("pid"),
+                "seq": snap.get("seq"),
+                "age_s": round(float(snap["age_s"]), 3),
+                "live": live,
+                "labels": snap.get("labels") or {},
+            }
+            role = (snap.get("labels") or {}).get("role")
+            if role:
+                roles[role] = roles.get(role, 0) + 1
+            workers.append(row)
+        if stale:
+            names = ",".join(s["worker"] for s in stale)
+            reasons.append(f"stale workers past ttl={self.ttl_s:g}s: {names}")
+
+        # --- SLO burn per worker (gauges carry worker= after the merge)
+        slo = {}
+        breaching_workers = set()
+        for s in self._series(merged, "slo_burn_rate"):
+            lb = s["labels"]
+            slo.setdefault(lb.get("worker", "?"), {})[
+                lb.get("objective", "?")] = s["value"]
+        for s in self._series(merged, "slo_breaching"):
+            if s["value"]:
+                breaching_workers.add(s["labels"].get("worker", "?"))
+        if breaching_workers:
+            reasons.append(
+                "slo breaching on: " + ",".join(sorted(breaching_workers)))
+
+        # --- memory census drift
+        census = {}
+        for name in ("memory_census_bytes", "memory_unattributed_bytes"):
+            for s in self._series(merged, name):
+                census.setdefault(
+                    s["labels"].get("worker", "?"), {})[name] = s["value"]
+        drift_alarms = sum(s["value"] for s in self._series(
+            merged, "memledger_drift_alarms_total"))
+        if drift_alarms:
+            reasons.append(f"memledger drift alarms: {int(drift_alarms)}")
+
+        # --- circuit breakers (replica_breaker_state: 2 == open)
+        breakers = []
+        for s in self._series(merged, "replica_breaker_state"):
+            lb = s["labels"]
+            state = {0.0: "closed", 1.0: "half_open", 2.0: "open"}.get(
+                s["value"], str(s["value"]))
+            breakers.append({"worker": lb.get("worker"),
+                             "replica": lb.get("replica"),
+                             "role": lb.get("role"), "state": state})
+            if s["value"] >= 2.0:
+                reasons.append(
+                    f"breaker open: {lb.get('replica')} on {lb.get('worker')}")
+
+        # --- KV tier occupancy
+        tiers: dict[str, dict] = {}
+        for name in ("kvtier_bytes", "kvtier_blocks"):
+            for s in self._series(merged, name):
+                t = s["labels"].get("tier", "?")
+                tiers.setdefault(t, {})[name] = \
+                    tiers.get(t, {}).get(name, 0.0) + s["value"]
+
+        # --- elastic heartbeats + restarts
+        heartbeats = {}
+        for s in self._series(merged, "worker_heartbeat_age_seconds"):
+            heartbeats[s["labels"].get("rank", "?")] = s["value"]
+        hb_dead = [r for r, age in heartbeats.items() if age > self.ttl_s]
+        if hb_dead:
+            reasons.append(
+                "heartbeat beacons past ttl for ranks: "
+                + ",".join(sorted(hb_dead)))
+        restarts = sum(s["value"] for s in self._series(
+            merged, "engine_loop_respawns_total"))
+        restarts += sum(s["value"] for s in self._series(
+            merged, "elastic_restarts_total"))
+
+        # --- verdict
+        if not fresh:
+            verdict = HEALTH_CRITICAL
+            reasons.append("no live worker snapshots")
+        elif breaching_workers and len(breaching_workers) >= len(fresh):
+            verdict = HEALTH_CRITICAL
+            reasons.append("every live worker is breaching its SLO")
+        elif reasons:
+            verdict = HEALTH_DEGRADED
+        else:
+            verdict = HEALTH_OK
+        if self.registry is not None:
+            self.registry.gauge(
+                "fleet_health",
+                "fleet rollup verdict: 0 ok | 1 degraded | 2 critical",
+            ).set(verdict)
+            self.registry.gauge(
+                "fleet_workers_live",
+                "workers with a fresh fleet snapshot").set(len(fresh))
+
+        return {
+            "ts": now,
+            "fleet_dir": self.fleet_dir,
+            "ttl_s": self.ttl_s,
+            "workers": workers,
+            "roles": roles,
+            "slo_burn": slo,
+            "census": census,
+            "breakers": breakers,
+            "kv_tiers": tiers,
+            "heartbeat_ages": heartbeats,
+            "restarts": restarts,
+            "health": {
+                "verdict": _VERDICT_NAMES[verdict],
+                "value": verdict,
+                "reasons": reasons,
+            },
+        }
+
+    def healthy(self, now: float | None = None) -> bool:
+        payload = self.debug_payload(now)
+        return payload["health"]["value"] == HEALTH_OK
+
+
+# ----------------------------------------------------------- trace stitching
+def _spill_sources(fleet_dir: str) -> list[dict]:
+    out = []
+    pattern = os.path.join(str(fleet_dir), f"{_TRACE_PREFIX}*.json")
+    for path in sorted(glob.glob(pattern)):
+        src = _read_json(path)
+        if (src is None or "spans" not in src
+                or "epoch_pc" not in src or "epoch_unix" not in src):
+            continue  # torn or pre-schema spill: skip, never fatal
+        out.append(src)
+    return out
+
+
+def merge_fleet_traces(fleet_dir: str, local_tracer=None,
+                       trace_id: str | None = None) -> dict:
+    """ONE Chrome trace-event JSON from every worker's spilled ring (plus
+    the local live ring when ``local_tracer`` is passed).
+
+    Cross-process clock alignment reuses the devprof anchor idea: every
+    tracer records an ``(epoch_pc, epoch_unix)`` pair at configure time, so
+    a span's ``perf_counter`` stamp maps onto the shared unix clock as
+    ``epoch_unix + (t0 - epoch_pc)``. Each worker gets its own Perfetto
+    process track (real pid + ``process_name`` metadata); spans deduplicate
+    on ``(trace_id, span_id)`` so a worker whose spill is also in the local
+    ring renders once.
+    """
+    sources = _spill_sources(fleet_dir)
+    if local_tracer is not None and getattr(local_tracer, "enabled", False):
+        state = local_tracer.spill_state()
+        state["worker"] = f"{default_worker_name()}(local)"
+        state["pid"] = os.getpid()
+        sources.append(state)
+
+    # global time base: earliest span start across the fleet (unix clock)
+    base = None
+    for src in sources:
+        e_pc, e_unix = float(src["epoch_pc"]), float(src["epoch_unix"])
+        for s in src.get("spans") or []:
+            t = e_unix + (float(s["t0"]) - e_pc)
+            if base is None or t < base:
+                base = t
+    if base is None:
+        base = time.time()
+
+    events: list[dict] = []
+    seen: set[tuple] = set()
+    worker_names: list[str] = []
+    used_pids: set[int] = set()
+    for i, src in enumerate(sources):
+        pid = int(src.get("pid", i + 1))
+        # two sources from one real pid (e.g. two in-process tracers in a
+        # test) must still land on distinct Perfetto process tracks
+        while pid in used_pids:
+            pid += 1
+        used_pids.add(pid)
+        worker = str(src.get("worker", f"w{pid}"))
+        e_pc, e_unix = float(src["epoch_pc"]), float(src["epoch_unix"])
+        emitted = False
+        for s in src.get("spans") or []:
+            if trace_id and s.get("trace_id") != trace_id:
+                continue
+            dedup = (s.get("trace_id"), s.get("span_id"))
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            args = dict(s.get("attrs") or {})
+            args["trace_id"] = s.get("trace_id")
+            args["span_id"] = s.get("span_id")
+            if s.get("parent_id"):
+                args["parent_id"] = s["parent_id"]
+            args["worker"] = worker
+            events.append({
+                "name": s["name"], "ph": "X", "cat": "request",
+                "ts": (e_unix + (float(s["t0"]) - e_pc) - base) * 1e6,
+                "dur": float(s.get("dur_s", 0.0)) * 1e6,
+                "pid": pid, "tid": s.get("tid", 0), "args": args,
+            })
+            emitted = True
+        if trace_id is None:
+            for c in src.get("counters") or []:
+                events.append({
+                    "name": c["track"], "ph": "C", "cat": "memory",
+                    "ts": (e_unix + (float(c["t"]) - e_pc) - base) * 1e6,
+                    "pid": pid, "args": c.get("values") or {},
+                })
+                emitted = True
+        if emitted:
+            worker_names.append(worker)
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": worker}})
+            events.append({"name": "process_sort_index", "ph": "M",
+                           "pid": pid, "args": {"sort_index": i}})
+
+    trace_ids = sorted({e["args"]["trace_id"] for e in events
+                        if e.get("ph") == "X" and e["args"].get("trace_id")})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "fleet": True,
+            "base_unix_s": base,
+            "workers": worker_names,
+            "trace_ids": trace_ids,
+            "spans": sum(1 for e in events if e.get("ph") == "X"),
+        },
+    }

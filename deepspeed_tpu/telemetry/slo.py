@@ -30,6 +30,9 @@ import threading
 import time
 from collections import deque
 
+# below this many in-window samples a breach verdict is noise, not signal
+MIN_SAMPLES = 5
+
 
 class SloObjective:
     """One rolling-window latency objective."""
@@ -64,14 +67,18 @@ class SloMonitor:
     """Records (timestamp, good?) samples per objective and publishes
     burn-rate gauges into the metrics registry at record and scrape time."""
 
-    # below this many in-window samples a breach verdict is noise, not signal
-    MIN_SAMPLES = 5
+    MIN_SAMPLES = MIN_SAMPLES
 
-    def __init__(self, objectives, registry, burn_threshold: float = 1.0):
+    def __init__(self, objectives, registry, burn_threshold: float = 1.0,
+                 replica: str | None = None):
         self._objectives = {o.name: o for o in objectives}
         self._samples = {o.name: deque() for o in objectives}
         self._registry = registry
         self.burn_threshold = float(burn_threshold)
+        # distinct replicas' monitors sharing one process (and therefore
+        # one registry) publish disjoint series via the replica= label;
+        # unnamed monitors keep the bare {objective=} series
+        self.replica = str(replica) if replica else None
         self._lock = threading.Lock()
 
     @property
@@ -136,18 +143,21 @@ class SloMonitor:
         # through; a wall-clock prune here would evict replayed samples)
         s = self.stats(name, now)
         reg = self._registry
+        labels = {"objective": name}
+        if self.replica is not None:
+            labels["replica"] = self.replica
         reg.gauge("slo_burn_rate",
                   "error-budget burn rate over the rolling window"
-                  ).set(s["burn_rate"], objective=name)
+                  ).set(s["burn_rate"], **labels)
         reg.gauge("slo_good_fraction",
                   "fraction of in-window requests meeting the objective"
-                  ).set(s["good_fraction"], objective=name)
+                  ).set(s["good_fraction"], **labels)
         reg.gauge("slo_window_requests",
                   "requests backing the rolling SLO estimate"
-                  ).set(s["count"], objective=name)
+                  ).set(s["count"], **labels)
         reg.gauge("slo_breaching",
                   "1 when burn rate exceeds the breach threshold"
-                  ).set(1.0 if s["breaching"] else 0.0, objective=name)
+                  ).set(1.0 if s["breaching"] else 0.0, **labels)
 
     def refresh_gauges(self) -> None:
         """Re-publish all gauges (call at scrape time so idle windows decay

@@ -242,6 +242,19 @@ class Tracer:
             spans = [s for s in spans if s["trace_id"] == trace_id]
         return spans
 
+    def spill_state(self) -> dict:
+        """Everything another process needs to stitch this ring onto a
+        shared timeline: the spans + counter samples and the
+        ``(perf_counter, unix)`` epoch anchor pair recorded at configure
+        time (``telemetry/fleet.py`` maps ``t0`` stamps onto the fleet
+        clock as ``epoch_unix + (t0 - epoch_pc)``)."""
+        return {
+            "epoch_pc": self._epoch_pc,
+            "epoch_unix": self._epoch_unix,
+            "spans": self.snapshot(),
+            "counters": list(self._counters),
+        }
+
     def export_chrome(self, trace_id: str | None = None) -> dict:
         """Chrome trace-event JSON (Perfetto-loadable): one ``ph: "X"``
         complete event per span, microsecond timestamps relative to the

@@ -253,7 +253,28 @@ def test_capture_dirs_rotate(tmp_path):
         assert prof.end() is not None
     caps = sorted(p for p in os.listdir(tmp_path) if p.startswith("cap-"))
     assert len(caps) == 2, f"rotation kept {caps}"
-    assert caps == ["cap-000003", "cap-000004"]
+    pid = os.getpid()
+    assert caps == [f"cap-{pid}-000003", f"cap-{pid}-000004"]
+
+
+def test_capture_dirs_per_worker(tmp_path):
+    """Regression: capture dirs are pid-scoped and rotation never touches a
+    sibling worker's captures in the same shared runs/devprof dir."""
+    foreign = [tmp_path / "cap-999999-000001", tmp_path / "cap-999999-000002",
+               tmp_path / "cap-999999-000003"]
+    for d in foreign:
+        d.mkdir()
+    prof = DeviceProfiler(out_dir=str(tmp_path), keep=1)
+    for _ in range(3):
+        assert prof.begin()
+        jax.block_until_ready(jnp.zeros((4, 4)) + 1.0)
+        assert prof.end() is not None
+    caps = sorted(p for p in os.listdir(tmp_path) if p.startswith("cap-"))
+    # every foreign (other-pid) dir survives; local ones rotated to keep=1
+    for d in foreign:
+        assert d.exists(), "rotation deleted another worker's capture"
+    local = [c for c in caps if c.startswith(f"cap-{os.getpid()}-")]
+    assert local == [f"cap-{os.getpid()}-000003"]
 
 
 def test_load_trace_dir_missing():

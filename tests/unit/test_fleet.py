@@ -1,0 +1,567 @@
+"""Fleet observability plane (telemetry/fleet.py + rollup wiring):
+cross-process metric federation merge semantics, atomic snapshot commit +
+torn-file tolerance, stale-worker expiry, trace stitching across workers
+onto one Perfetto timeline, fleet_health verdicts, the HTTP rollup surface
+(/debug/fleet, /metrics/fleet, /healthz degradation), per-replica SLO
+labels, heartbeat-age gauges, the pipeline-transport traceparent hop, the
+KV-handoff trace seam, and the off-is-free pin (tracemalloc)."""
+
+import http.client
+import json
+import os
+import time
+import tracemalloc
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.elasticity.agent import beacon_ages, publish_heartbeat_ages
+from deepspeed_tpu.inference.ragged import RaggedConfig, RaggedInferenceEngine
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.runtime.pipe.transport import InProcTransport
+from deepspeed_tpu.serving import (
+    EngineLoop,
+    ReplicaRouter,
+    RouterConfig,
+    ServingFrontend,
+)
+from deepspeed_tpu.telemetry.fleet import (
+    FLEET_SCHEMA,
+    FleetAggregator,
+    FleetReporter,
+    merge_fleet_traces,
+    merge_metric_snapshots,
+    render_federated_prometheus,
+)
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.slo import SloMonitor, default_objectives
+from deepspeed_tpu.telemetry.tracing import Tracer, format_traceparent
+
+CFG = llama.LlamaConfig(
+    vocab_size=97, hidden_size=32, intermediate_size=64,
+    num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+)
+RCFG = RaggedConfig(
+    max_tokens_per_step=16, max_seqs=3, block_size=4,
+    num_blocks=49, max_blocks_per_seq=16,
+)
+
+
+def _engine():
+    return RaggedInferenceEngine(
+        lambda ctx: llama.build(CFG, ctx=ctx), RCFG, dtype=jnp.float32, seed=0)
+
+
+def _prompt(n, seed=0):
+    return [int(t) for t in
+            np.random.default_rng(seed).integers(1, CFG.vocab_size, n)]
+
+
+def _drain(eng, max_steps=500):
+    for _ in range(max_steps):
+        eng.step()
+        if not eng.has_work:
+            return
+    raise AssertionError("engine did not drain")
+
+
+class _Worker:
+    """Stand-in for one process's telemetry singleton: a private registry +
+    tracer pair, enough for a FleetReporter (no global side effects)."""
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.tracer = Tracer(self.registry)
+
+
+def _reporter(tmp_path, name, labels=None, tracing=False):
+    w = _Worker()
+    if tracing:
+        w.tracer.configure(enabled=True)
+    rep = FleetReporter(w, out_dir=str(tmp_path), worker=name, labels=labels)
+    return w, rep
+
+
+# ------------------------------------------------------ snapshot commit
+class TestAtomicSnapshots:
+    def test_publish_atomic_no_temp_left(self, tmp_path):
+        w, rep = _reporter(tmp_path, "w1")
+        w.registry.counter("c", "").inc(3)
+        path = rep.publish()
+        assert os.path.basename(path) == "metrics_w1.json"
+        # commit protocol: temp + fsync + rename — nothing transient left
+        assert not [f for f in os.listdir(tmp_path) if "tmp" in f]
+        snap = json.load(open(path))
+        assert snap["schema"] == FLEET_SCHEMA
+        assert snap["worker"] == "w1" and snap["pid"] == os.getpid()
+        assert snap["metrics"]["c"]["series"][0]["value"] == 3
+
+    def test_seq_increments_per_publish(self, tmp_path):
+        _, rep = _reporter(tmp_path, "w1")
+        rep.publish()
+        rep.publish()
+        assert json.load(open(rep.metrics_path))["seq"] == 2
+
+    def test_torn_and_foreign_files_skipped(self, tmp_path):
+        w, rep = _reporter(tmp_path, "good")
+        w.registry.counter("c", "").inc()
+        rep.publish()
+        # torn write, non-JSON junk, and a schema-less snapshot: all ignored
+        (tmp_path / "metrics_torn.json").write_text('{"worker": "torn", "me')
+        (tmp_path / "metrics_junk.json").write_bytes(b"\x00\x01binary")
+        (tmp_path / "metrics_old.json").write_text(
+            json.dumps({"worker": "old", "ts": time.time(), "metrics": {}}))
+        agg = FleetAggregator(str(tmp_path), ttl_s=300.0)
+        fresh, stale = agg.read_snapshots()
+        assert [s["worker"] for s in fresh] == ["good"]
+        assert stale == []
+
+    def test_stale_expiry_splits_by_ttl(self, tmp_path):
+        w1, rep1 = _reporter(tmp_path, "live")
+        w2, rep2 = _reporter(tmp_path, "dead")
+        rep1.publish()
+        rep2.publish(now=time.time() - 120.0)
+        agg = FleetAggregator(str(tmp_path), ttl_s=30.0)
+        fresh, stale = agg.read_snapshots()
+        assert [s["worker"] for s in fresh] == ["live"]
+        assert [s["worker"] for s in stale] == ["dead"]
+        payload = agg.debug_payload()
+        assert payload["health"]["verdict"] == "degraded"
+        assert any("stale" in r for r in payload["health"]["reasons"])
+        # stale workers still listed, flagged not-live
+        rows = {r["worker"]: r["live"] for r in payload["workers"]}
+        assert rows == {"live": True, "dead": False}
+
+
+# --------------------------------------------------------- merge semantics
+class TestMergeSemantics:
+    def _snap(self, worker, labels=None, fill=None):
+        w = _Worker()
+        if fill:
+            fill(w.registry)
+        return {"schema": FLEET_SCHEMA, "worker": worker, "pid": 1,
+                "ts": time.time(), "seq": 1, "labels": labels or {},
+                "metrics": w.registry.snapshot()}
+
+    def test_counters_sum_across_workers(self):
+        a = self._snap("a", fill=lambda r: r.counter("req", "").inc(3))
+        b = self._snap("b", fill=lambda r: r.counter("req", "").inc(4))
+        merged = merge_metric_snapshots([a, b])
+        assert merged["req"]["kind"] == "counter"
+        assert [s["value"] for s in merged["req"]["series"]] == [7]
+
+    def test_counter_label_sets_stay_distinct(self):
+        a = self._snap("a", fill=lambda r: r.counter("req", "").inc(
+            2, route="x"))
+        b = self._snap("b", fill=lambda r: r.counter("req", "").inc(
+            5, route="y"))
+        merged = merge_metric_snapshots([a, b])
+        got = {tuple(sorted(s["labels"].items())): s["value"]
+               for s in merged["req"]["series"]}
+        assert got == {(("route", "x"),): 2, (("route", "y"),): 5}
+
+    def test_gauges_keep_per_worker_series(self):
+        a = self._snap("a", labels={"role": "prefill"},
+                       fill=lambda r: r.gauge("depth", "").set(2))
+        b = self._snap("b", labels={"role": "decode"},
+                       fill=lambda r: r.gauge("depth", "").set(5))
+        merged = merge_metric_snapshots([a, b])
+        got = {s["labels"]["worker"]: (s["value"], s["labels"]["role"])
+               for s in merged["depth"]["series"]}
+        assert got == {"a": (2, "prefill"), "b": (5, "decode")}
+
+    def test_reporter_labels_do_not_override_series_labels(self):
+        # a series that already carries role= keeps its own value; the
+        # reporter-level label only fills the gap
+        a = self._snap("a", labels={"role": "reporter"},
+                       fill=lambda r: r.gauge("g", "").set(1, role="series"))
+        merged = merge_metric_snapshots([a])
+        assert merged["g"]["series"][0]["labels"]["role"] == "series"
+
+    def test_histogram_buckets_add(self):
+        def fill(v):
+            def _f(r):
+                h = r.histogram("lat", "", buckets=(0.1, 1.0))
+                h.observe(v)
+            return _f
+        merged = merge_metric_snapshots(
+            [self._snap("a", fill=fill(0.05)),
+             self._snap("b", fill=fill(0.5))])
+        s = merged["lat"]["series"][0]
+        assert s["count"] == 2
+        assert s["sum"] == pytest.approx(0.55)
+        assert s["buckets"]["0.1"] == 1      # cumulative: only the 0.05 obs
+        assert s["buckets"]["1.0"] == 2
+        assert s["buckets"]["+Inf"] == 2
+
+    def test_kind_conflict_first_wins(self):
+        a = self._snap("a", fill=lambda r: r.counter("m", "").inc())
+        b = self._snap("b", fill=lambda r: r.gauge("m", "").set(9))
+        merged = merge_metric_snapshots([a, b])
+        assert merged["m"]["kind"] == "counter"
+        assert [s["value"] for s in merged["m"]["series"]] == [1]
+
+    def test_render_federated_prometheus(self):
+        a = self._snap("a", fill=lambda r: (
+            r.counter("req", "requests").inc(3),
+            r.gauge("depth", "").set(2),
+            r.histogram("lat", "", buckets=(0.1,)).observe(0.05)))
+        b = self._snap("b", fill=lambda r: r.gauge("depth", "").set(5))
+        text = render_federated_prometheus(merge_metric_snapshots([a, b]))
+        assert "# TYPE req counter" in text
+        assert "req 3" in text
+        assert 'depth{worker="a"} 2' in text
+        assert 'depth{worker="b"} 5' in text
+        lines = [l for l in text.splitlines() if l.startswith("lat_bucket")]
+        assert lines and '+Inf' in lines[-1]  # +Inf renders last
+
+
+# ----------------------------------------------------------- health rollup
+class TestHealthRollup:
+    def _publish(self, tmp_path, name, fill=None, labels=None, now=None):
+        w, rep = _reporter(tmp_path, name, labels=labels)
+        if fill:
+            fill(w.registry)
+        rep.publish(now=now)
+
+    def test_verdict_ok(self, tmp_path):
+        self._publish(tmp_path, "a", labels={"role": "prefill"})
+        self._publish(tmp_path, "b", labels={"role": "decode"})
+        agg = FleetAggregator(str(tmp_path), ttl_s=300.0)
+        payload = agg.debug_payload()
+        assert payload["health"] == {
+            "verdict": "ok", "value": 0.0, "reasons": []}
+        assert payload["roles"] == {"prefill": 1, "decode": 1}
+        assert agg.healthy()
+
+    def test_verdict_critical_without_snapshots(self, tmp_path):
+        payload = FleetAggregator(str(tmp_path), ttl_s=1.0).debug_payload()
+        assert payload["health"]["verdict"] == "critical"
+        assert "no live worker snapshots" in payload["health"]["reasons"]
+
+    def test_one_breaching_worker_degrades(self, tmp_path):
+        self._publish(tmp_path, "a", fill=lambda r: r.gauge(
+            "slo_breaching", "").set(1, objective="ttft"))
+        self._publish(tmp_path, "b")
+        payload = FleetAggregator(str(tmp_path), ttl_s=300.0).debug_payload()
+        assert payload["health"]["verdict"] == "degraded"
+        assert any("slo breaching" in r for r in payload["health"]["reasons"])
+
+    def test_every_worker_breaching_is_critical(self, tmp_path):
+        for name in ("a", "b"):
+            self._publish(tmp_path, name, fill=lambda r: r.gauge(
+                "slo_breaching", "").set(1, objective="ttft"))
+        payload = FleetAggregator(str(tmp_path), ttl_s=300.0).debug_payload()
+        assert payload["health"]["verdict"] == "critical"
+
+    def test_open_breaker_degrades(self, tmp_path):
+        self._publish(tmp_path, "a", fill=lambda r: r.gauge(
+            "replica_breaker_state", "").set(2, replica="d0", role="decode"))
+        payload = FleetAggregator(str(tmp_path), ttl_s=300.0).debug_payload()
+        assert payload["health"]["verdict"] == "degraded"
+        assert payload["breakers"][0]["state"] == "open"
+
+    def test_stale_heartbeat_gauge_degrades(self, tmp_path):
+        self._publish(tmp_path, "a", fill=lambda r: r.gauge(
+            "worker_heartbeat_age_seconds", "").set(400.0, rank="3"))
+        agg = FleetAggregator(str(tmp_path), ttl_s=300.0)
+        payload = agg.debug_payload()
+        assert payload["heartbeat_ages"] == {"3": 400.0}
+        assert payload["health"]["verdict"] == "degraded"
+        assert any("heartbeat" in r for r in payload["health"]["reasons"])
+
+    def test_fleet_health_gauges_published(self, tmp_path):
+        self._publish(tmp_path, "a")
+        reg = MetricsRegistry()
+        FleetAggregator(str(tmp_path), ttl_s=300.0,
+                        registry=reg).debug_payload()
+        assert reg.gauge("fleet_health").value() == 0.0
+        assert reg.gauge("fleet_workers_live").value() == 1.0
+
+    def test_slo_burn_and_census_rollup(self, tmp_path):
+        self._publish(tmp_path, "a", fill=lambda r: (
+            r.gauge("slo_burn_rate", "").set(0.5, objective="ttft"),
+            r.gauge("memory_census_bytes", "").set(1024),
+            r.counter("elastic_restarts_total", "").inc(2)))
+        payload = FleetAggregator(str(tmp_path), ttl_s=300.0).debug_payload()
+        assert payload["slo_burn"] == {"a": {"ttft": 0.5}}
+        assert payload["census"]["a"]["memory_census_bytes"] == 1024
+        assert payload["restarts"] == 2
+
+
+# --------------------------------------------------------- trace stitching
+class TestTraceStitching:
+    def _two_worker_spill(self, tmp_path):
+        """Worker A records a root span; worker B continues the SAME trace
+        from A's traceparent (the cross-process seam in miniature)."""
+        wa, ra = _reporter(tmp_path, "wa", tracing=True)
+        wb, rb = _reporter(tmp_path, "wb", tracing=True)
+        root = wa.tracer.extract(None)
+        t = time.perf_counter()
+        wa.tracer.finish(root, "prefill/request", t, t + 0.01, role="prefill")
+        child = wb.tracer.extract(format_traceparent(root))
+        wb.tracer.finish(child, "decode/resume", t + 0.02, t + 0.05,
+                         role="decode")
+        ra.flush()
+        rb.flush()
+        return root, child
+
+    def test_single_trace_two_process_tracks(self, tmp_path):
+        root, child = self._two_worker_spill(tmp_path)
+        merged = merge_fleet_traces(str(tmp_path))
+        assert merged["otherData"]["trace_ids"] == [root.trace_id]
+        spans = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+        assert len(spans) == 2
+        # distinct Perfetto process tracks even when spilled from one pid
+        assert len({e["pid"] for e in spans}) == 2
+        names = {e["name"] for e in merged["traceEvents"] if e["ph"] == "M"}
+        assert "process_name" in names
+        assert sorted(merged["otherData"]["workers"]) == ["wa", "wb"]
+
+    def test_span_link_survives_the_seam(self, tmp_path):
+        root, child = self._two_worker_spill(tmp_path)
+        merged = merge_fleet_traces(str(tmp_path))
+        by_name = {e["name"]: e for e in merged["traceEvents"]
+                   if e["ph"] == "X"}
+        resume = by_name["decode/resume"]["args"]
+        assert resume["trace_id"] == root.trace_id
+        assert resume["parent_id"] == root.span_id
+
+    def test_clock_alignment_preserves_order(self, tmp_path):
+        self._two_worker_spill(tmp_path)
+        merged = merge_fleet_traces(str(tmp_path))
+        by_name = {e["name"]: e for e in merged["traceEvents"]
+                   if e["ph"] == "X"}
+        assert by_name["prefill/request"]["ts"] <= by_name["decode/resume"]["ts"]
+        assert all(e["ts"] >= 0 for e in merged["traceEvents"]
+                   if e["ph"] == "X")
+
+    def test_trace_id_filter(self, tmp_path):
+        wa, ra = _reporter(tmp_path, "wa", tracing=True)
+        t = time.perf_counter()
+        keep = wa.tracer.extract(None)
+        drop = wa.tracer.extract(None)
+        wa.tracer.finish(keep, "keep", t, t + 0.01)
+        wa.tracer.finish(drop, "drop", t, t + 0.01)
+        ra.flush()
+        merged = merge_fleet_traces(str(tmp_path), trace_id=keep.trace_id)
+        assert [e["name"] for e in merged["traceEvents"]
+                if e["ph"] == "X"] == ["keep"]
+
+    def test_local_ring_dedups_against_own_spill(self, tmp_path):
+        wa, ra = _reporter(tmp_path, "wa", tracing=True)
+        ctx = wa.tracer.extract(None)
+        t = time.perf_counter()
+        wa.tracer.finish(ctx, "once", t, t + 0.01)
+        ra.flush()
+        # the same ring arrives twice: spilled file + live local tracer
+        merged = merge_fleet_traces(str(tmp_path), local_tracer=wa.tracer)
+        assert [e["name"] for e in merged["traceEvents"]
+                if e["ph"] == "X"] == ["once"]
+
+
+# ------------------------------------------------------ SLO replica labels
+class TestSloReplicaLabels:
+    def test_two_monitors_publish_disjoint_series(self):
+        reg = MetricsRegistry()
+        objectives = default_objectives()
+        mon_a = SloMonitor(objectives, reg, replica="prefill-0")
+        mon_b = SloMonitor(objectives, reg, replica="decode-0")
+        for _ in range(SloMonitor.MIN_SAMPLES + 1):
+            mon_a.record("ttft", 0.001)
+            mon_b.record("ttft", 99.0)
+        series = reg.gauge("slo_burn_rate").snapshot()
+        by_replica = {s["labels"].get("replica"): s["value"]
+                      for s in series if s["labels"]["objective"] == "ttft"}
+        assert set(by_replica) == {"prefill-0", "decode-0"}
+        assert by_replica["prefill-0"] < by_replica["decode-0"]
+
+    def test_unnamed_monitor_keeps_bare_series(self):
+        reg = MetricsRegistry()
+        mon = SloMonitor(default_objectives(), reg)
+        for _ in range(SloMonitor.MIN_SAMPLES + 1):
+            mon.record("ttft", 0.001)
+        series = reg.gauge("slo_burn_rate").snapshot()
+        assert all("replica" not in s["labels"] for s in series)
+
+
+# -------------------------------------------------------- heartbeat gauges
+class TestHeartbeatAges:
+    def test_beacon_ages_worst_of_stage_beacons(self, tmp_path):
+        now = time.time()
+        p_main = tmp_path / "heartbeat_0.json"
+        p_stage = tmp_path / "heartbeat_0_s1.json"
+        for p in (p_main, p_stage):
+            p.write_text("{}")
+        os.utime(p_main, (now - 1.0, now - 1.0))
+        os.utime(p_stage, (now - 50.0, now - 50.0))  # wedged stage thread
+        ages = beacon_ages(str(tmp_path), now=now)
+        assert set(ages) == {0}
+        assert ages[0] == pytest.approx(50.0, abs=2.0)
+
+    def test_publish_gauges_with_rank_labels(self, tmp_path):
+        now = time.time()
+        for rank in (0, 1):
+            p = tmp_path / f"heartbeat_{rank}.json"
+            p.write_text("{}")
+            os.utime(p, (now - 5.0, now - 5.0))
+        telemetry.configure(enabled=True)
+        ages = publish_heartbeat_ages(str(tmp_path),
+                                      telemetry=telemetry.TELEMETRY)
+        assert set(ages) == {0, 1}
+        g = telemetry.TELEMETRY.registry.gauge("worker_heartbeat_age_seconds")
+        for rank in ("0", "1"):
+            assert g.value(rank=rank) > 0
+
+    def test_missing_dir_is_empty(self, tmp_path):
+        assert beacon_ages(str(tmp_path / "nope")) == {}
+        assert publish_heartbeat_ages(None) == {}
+
+
+# -------------------------------------------------- transport trace seam
+class TestTransportHop:
+    def test_hop_recorded_under_sender_context(self):
+        telemetry.configure(enabled=True, tracing=True)
+        tracer = telemetry.TELEMETRY.tracer
+        ctx = tracer.extract(None)
+        tp = InProcTransport(poll_interval_s=0.01)
+        tp.send(0, 1, "act", 0, "payload", traceparent=format_traceparent(ctx))
+        payload, waited = tp.recv(0, 1, "act", 0)
+        assert payload == "payload" and waited >= 0.0
+        spans = [s for s in tracer.snapshot() if s["name"] == "pipe/recv_act"]
+        assert len(spans) == 1
+        assert spans[0]["trace_id"] == ctx.trace_id
+        assert spans[0]["parent_id"] == ctx.span_id
+        assert spans[0]["attrs"] == {"src": 0, "dst": 1, "mb": 0}
+
+    def test_untraced_payload_passes_raw(self):
+        telemetry.configure(enabled=True, tracing=True)
+        tp = InProcTransport(poll_interval_s=0.01)
+        sent = object()
+        tp.send(0, 1, "act", 0, sent)
+        payload, _ = tp.recv(0, 1, "act", 0)
+        assert payload is sent
+        assert telemetry.TELEMETRY.tracer.snapshot() == []
+
+
+# ------------------------------------------------------ KV handoff seam
+class TestHandoffTraceSeam:
+    def test_handoff_carries_one_trace_across_engines(self):
+        telemetry.configure(enabled=True, tracing=True)
+        tracer = telemetry.TELEMETRY.tracer
+        root = tracer.extract(None)
+        pre = _engine()
+        pre.put("req", _prompt(9), max_new_tokens=4, handoff=True, trace=root)
+        _drain(pre)
+        rec = pre.export_handoff("req")
+        assert rec is not None
+        assert rec.traceparent is not None
+        assert root.trace_id in rec.traceparent
+        dec = RaggedInferenceEngine(
+            lambda ctx: llama.build(CFG, ctx=ctx), RCFG,
+            dtype=jnp.float32, seed=0, params=pre.params)
+        assert dec.import_handoff(rec)
+        _drain(dec)
+        spans = [s for s in tracer.snapshot()
+                 if s["name"] == "engine/request"]
+        # prefill half + decode half, stitched onto ONE trace id
+        assert len(spans) == 2
+        assert {s["trace_id"] for s in spans} == {root.trace_id}
+
+    def test_untraced_handoff_has_no_traceparent(self):
+        pre = _engine()
+        pre.put("req", _prompt(9), max_new_tokens=4, handoff=True)
+        _drain(pre)
+        rec = pre.export_handoff("req")
+        assert rec is not None and rec.traceparent is None
+
+
+# -------------------------------------------------------- HTTP surface
+class TestFleetHttpSurface:
+    def _get(self, frontend, path):
+        conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                          timeout=60)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        conn.close()
+        ctype = resp.getheader("Content-Type") or ""
+        return resp.status, (json.loads(body) if "json" in ctype else body)
+
+    def test_debug_metrics_and_healthz_degradation(self, tmp_path):
+        # local worker via the configured reporter + one breaching remote
+        telemetry.configure(
+            enabled=True,
+            fleet={"enabled": True, "dir": str(tmp_path), "worker": "local",
+                   "labels": {"role": "decode"}})
+        telemetry.TELEMETRY.counter("c", "").inc()
+        telemetry.TELEMETRY.fleet.flush()
+        w, rep = _reporter(tmp_path, "remote", labels={"role": "prefill"})
+        w.registry.gauge("slo_breaching", "").set(1, objective="ttft")
+        rep.publish()
+
+        eng = _engine()
+        loop = EngineLoop(eng, name="fleet-test")
+        frontend = ServingFrontend(
+            ReplicaRouter([loop], RouterConfig()), fleet_dir=str(tmp_path))
+        frontend.start()
+        try:
+            st, debug = self._get(frontend, "/debug/fleet")
+            assert st == 200
+            assert {r["worker"] for r in debug["workers"]} == {
+                "local", "remote"}
+            assert debug["health"]["verdict"] == "degraded"
+
+            st, page = self._get(frontend, "/metrics/fleet")
+            assert st == 200
+            assert 'worker="local"' in page or "c 1" in page
+            assert ('slo_breaching{objective="ttft",role="prefill",'
+                    'worker="remote"} 1') in page
+
+            st, health = self._get(frontend, "/healthz")
+            assert st == 200
+            assert health["fleet"]["verdict"] == "degraded"
+            assert health["status"] == "degraded"
+        finally:
+            frontend.close()
+
+        # no fleet configured anywhere: the surface reports disabled
+        telemetry.TELEMETRY.reset()
+        frontend = ServingFrontend(ReplicaRouter([loop], RouterConfig()))
+        frontend.start()
+        try:
+            st, debug = self._get(frontend, "/debug/fleet")
+            assert st == 200 and debug == {"enabled": False}
+            st, _ = self._get(frontend, "/metrics/fleet")
+            assert st == 404
+        finally:
+            frontend.close()
+
+
+# ------------------------------------------------------------ off is free
+class TestOffIsFree:
+    def test_disabled_fleet_and_tracing_zero_alloc(self):
+        """Telemetry off: serving a request + pumping untraced transport
+        hops must execute zero fleet.py/tracing.py code (tracemalloc pin —
+        the ISSUE's zero-alloc acceptance)."""
+        eng = _engine()
+        eng.put("warm", _prompt(8), max_new_tokens=4)
+        _drain(eng)
+        tp = InProcTransport(poll_interval_s=0.001)
+        tracemalloc.start()
+        try:
+            eng.put("pin", _prompt(8, seed=1), max_new_tokens=4)
+            _drain(eng)
+            for mb in range(50):
+                tp.send(0, 1, "act", mb, ("x", mb))
+                tp.recv(0, 1, "act", mb)
+            snap = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        for pattern in ("*/telemetry/fleet.py", "*/telemetry/tracing.py"):
+            stats = snap.filter_traces(
+                [tracemalloc.Filter(True, pattern)]).statistics("filename")
+            total = sum(s.size for s in stats)
+            assert total == 0, f"{pattern} allocated {total}B while disabled"
