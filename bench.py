@@ -1079,6 +1079,16 @@ def serving_bench_main():
     # working set overflows it by >=3x, and let the engine demote evicted
     # prefix blocks host-ward instead of dropping them (docs/SERVING.md)
     kv_tier = e.get("BENCH_SERVING_KV_TIER", "") not in ("", "0")
+    # low-bit KV serving (--kv-quant): the tiered workload with the pool,
+    # tier payloads, prefix splices and handoffs all running the named
+    # codec (docs/SERVING.md "Low-bit serving"). Implies --kv-tier so the
+    # combined hit rate measures restores of *quantized* payloads, and
+    # adds a quant-vs-fp drift probe to the verdict.
+    kv_quant = e.get("BENCH_SERVING_KV_QUANT", "")
+    if kv_quant in ("0", "off"):
+        kv_quant = ""
+    if kv_quant:
+        kv_tier = True
     if kv_tier and shared_prefix == 0:
         shared_prefix = 2 * block  # two full blocks per prefix group
 
@@ -1108,7 +1118,8 @@ def serving_bench_main():
         kv_tier_disk_blocks=8 * mbs,
         kv_tier_dir=os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "runs", "kvtier",
-            f"bench-{os.getpid()}"))
+            f"bench-{os.getpid()}"),
+        quant=kv_quant or "off")
     engine = RaggedInferenceEngine(
         model=lambda ctx: llama.build(model_cfg, ctx=ctx),
         ragged_config=rcfg, seed=0)
@@ -1263,6 +1274,47 @@ def serving_bench_main():
             "parity_ok": parity_ok,
             **{f"kvtier_{k}": v for k, v in st.items()},
         }
+    kv_quant_stats = {}
+    if kv_quant:
+        from deepspeed_tpu.inference import kvquant as _kvq
+
+        qst = engine.kv_quant_stats() or {}
+
+        # drift probe: the SAME prompts through a quant-off and a quant-on
+        # engine (spec decode on, so the verdict covers both budget axes:
+        # greedy token-match rate and spec accept-rate drift)
+        def _probe(qspec):
+            pcfg = RaggedConfig(
+                max_tokens_per_step=budget, max_seqs=2, block_size=block,
+                num_blocks=2 * mbs + 1, max_blocks_per_seq=mbs,
+                sched_steps=8, spec_draft=4, quant=qspec)
+            pe = RaggedInferenceEngine(
+                model=lambda ctx: llama.build(model_cfg, ctx=ctx),
+                ragged_config=pcfg, seed=0)
+            for i in range(3):
+                pe.put(i, [int(t) for t in prompts[i][:32]],
+                       max_new_tokens=12)
+            toks = pe.generate_all()
+            acc = (pe.spec_accepted / pe.spec_proposed
+                   if pe.spec_proposed else None)
+            return toks, acc
+
+        base_toks, base_acc = _probe("off")
+        q_toks, q_acc = _probe(kv_quant)
+        match = _kvq.token_match_rate(base_toks, q_toks)
+        drift = (abs(q_acc - base_acc)
+                 if base_acc is not None and q_acc is not None else None)
+        kv_quant_stats = {
+            "enabled": True,
+            "codec": qst.get("codec", kv_quant),
+            "resident_block_multiplier":
+                round(qst.get("resident_multiplier_vs_fp16", 0.0), 4),
+            "kv_block_bytes": qst.get("block_bytes"),
+            "fp16_block_bytes": qst.get("fp16_block_bytes"),
+            "blocks_allocated_total": qst.get("blocks_allocated_total"),
+            "bytes_saved_total": qst.get("bytes_saved_total"),
+            "drift": _kvq.drift_verdict(match, drift),
+        }
     # memory-ledger picture BEFORE close() tears the ledger down: per-owner
     # bytes + the final census gap (the leak detector's reading for the run)
     led = telemetry.TELEMETRY.memledger
@@ -1300,6 +1352,7 @@ def serving_bench_main():
         "serving_rate_rps": rate,
         **cache_stats,
         **({"kv_tier": kv_tier_stats} if kv_tier_stats else {}),
+        **({"kv_quant": kv_quant_stats} if kv_quant_stats else {}),
         "serving_completed": len(done),
         "serving_rejected": rejected,
         "serving_rejected_rate": round(rejected / max(1, len(results)), 4),
@@ -2996,6 +3049,14 @@ def main():
             # tiers, repeated shared-prefix prompts, occurrence-parity and
             # demotion/promotion/prefetch counters in the JSON verdict
             os.environ["BENCH_SERVING_KV_TIER"] = "1"
+        if "--kv-quant" in sys.argv:
+            # low-bit KV serving trial: the tiered workload with an int8
+            # (or fp8: `--kv-quant fp8`) pool — resident-block multiplier,
+            # combined tier hit rate over quantized payloads, and the
+            # quant-vs-fp drift verdict in the JSON line
+            val = sys.argv[sys.argv.index("--kv-quant") + 1:][:1]
+            codec = val[0] if val and val[0] in ("int8", "fp8") else "int8"
+            os.environ["BENCH_SERVING_KV_QUANT"] = codec
         result, err = run_serving_subprocess()
         if result is None:
             print(f"serving bench failed:\n{_err_text(err)}", file=sys.stderr)

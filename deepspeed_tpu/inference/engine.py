@@ -43,6 +43,7 @@ class InferenceEngine:
         seed: int = 0,
         quantize_bits: int = 0,
         quantize_block: int = 256,
+        quant: str = "off",
     ):
         if topology_initialized():
             self.topo = get_topology()
@@ -80,10 +81,24 @@ class InferenceEngine:
         )
         if checkpoint is not None:
             self.load_checkpoint(checkpoint)
+        # ONE low-bit config surface shared with the ragged engine
+        # (inference/kvquant.py): the woq component merges with the
+        # back-compat quantize_bits arg; '+qcol' quantizes the TP logits
+        # all-gather; a KV codec only applies to the paged pool, so it is
+        # accepted-but-inert on this dense-cache engine (logged).
+        from deepspeed_tpu.inference import kvquant
+
+        parsed = kvquant.parse_quant(quant)
+        self._qcol = parsed.qcol and self.topo.size("tensor") > 1
+        if parsed.kv is not None:
+            log_dist(
+                f"InferenceEngine: quant KV codec {parsed.kv.name!r} applies "
+                "to the paged pool (RaggedInferenceEngine); inert on the "
+                "dense-cache engine", ranks=[0])
         # weight-only quantization (reference inference/quantization/ WOQ):
         # >=2D weights stored int8/int4 blockwise, dequantized just in time
         # per scanned layer (models call ops.quantizer.maybe_dequantize)
-        self.quantize_bits = int(quantize_bits)
+        self.quantize_bits = int(quantize_bits) or parsed.woq_bits
         self._quantize_block = quantize_block
         if self.quantize_bits:
             self.params = self._quantize(self.params)
@@ -91,9 +106,21 @@ class InferenceEngine:
         log_dist(
             f"InferenceEngine: model={self.spec.name} tp={self.topo.size('tensor')} "
             f"dtype={jnp.dtype(dtype).name}"
-            + (f" woq=int{self.quantize_bits}" if self.quantize_bits else ""),
+            + (f" woq=int{self.quantize_bits}" if self.quantize_bits else "")
+            + (" qcol" if self._qcol else ""),
             ranks=[0],
         )
+
+    def _maybe_qcol(self, logits):
+        """'+qcol': route logits through the quantized TP all-gather (an
+        explicit int8-wire shard_map region) instead of GSPMD's implicit fp
+        gather. Traced inside the jitted generate/forward programs."""
+        if not self._qcol:
+            return logits
+        from deepspeed_tpu.inference import kvquant
+
+        return kvquant.quantized_logits_all_gather(
+            logits, self.topo.mesh, axis="tensor")
 
     def _quantize(self, params):
         from deepspeed_tpu.ops.quantizer import quantize_params
@@ -162,7 +189,8 @@ class InferenceEngine:
 
             cache = init_cache(batch, total, self.dtype)
             logits, cache = decode(params, tokens, cache, 0)
-            last = logits[:, prompt_len - 1].astype(jnp.float32)
+            last = self._maybe_qcol(
+                logits[:, prompt_len - 1]).astype(jnp.float32)
             vocab = last.shape[-1]
             # occurrence mask over the prompt (HF repetition_penalty
             # semantics: penalize everything in the context)
@@ -191,7 +219,8 @@ class InferenceEngine:
                 if use_penalty:
                     seen = update_seen(seen, tok)
                 logits, cache = decode(params, tok[:, None], cache, prompt_len + i)
-                return (logits[:, 0].astype(jnp.float32), cache, seen), tok
+                return (self._maybe_qcol(logits[:, 0]).astype(jnp.float32),
+                        cache, seen), tok
 
             (_, _, _), toks = jax.lax.scan(
                 step, (last, cache, seen0), jnp.arange(max_new))
@@ -270,7 +299,10 @@ def init_inference(model, config: dict | None = None, **kwargs):
     if dtype_str in ("int8", "qint8"):
         bits = 8
     quant = config.get("quant")
-    if isinstance(quant, dict) and quant.get("enabled", True):
+    quant_str = "off"
+    if isinstance(quant, str):  # kvquant grammar: e.g. "int8+woq8+qcol"
+        quant_str = quant
+    elif isinstance(quant, dict) and quant.get("enabled", True):
         bits = int((quant.get("weight") or {}).get("num_bits", bits or 8))
     return InferenceEngine(
         model,
@@ -280,4 +312,5 @@ def init_inference(model, config: dict | None = None, **kwargs):
         checkpoint=config.get("checkpoint"),
         quantize_bits=int(config.get("quantize_bits", bits)),
         quantize_block=int(config.get("quantize_block", 256)),
+        quant=quant_str,
     )

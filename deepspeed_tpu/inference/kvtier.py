@@ -56,11 +56,20 @@ import numpy as np
 __all__ = [
     "DiskTier",
     "HostTier",
+    "KVCodecMismatch",
     "KVTierStore",
     "frame_bytes",
     "restore_beats_prefill",
     "unframe_bytes",
 ]
+
+
+class KVCodecMismatch(ValueError):
+    """A persisted KV record was written under a different quantization
+    codec than this engine runs (``RaggedConfig.quant``). Dequantizing it
+    anyway would splice numerically wrong KV, so reads RAISE instead of
+    missing — unlike corruption, which reads as a miss, a codec mismatch
+    is a configuration error the operator must see."""
 
 # framing magics: one for tier-2 spill records, one for serialized KVHandoff
 # payloads (shared integrity check, distinct container types)
@@ -193,8 +202,15 @@ class HostTier:
 class DiskTier:
     """Spill directory of demoted KV block records (one file per block).
 
-    Record format: ``RECORD_MAGIC`` + frame(pickled chain key) +
-    frame(pickled payload pytree), each frame length+sha256 checked. Writes
+    Record format: ``RECORD_MAGIC`` + frame(pickled ``{"key": chain_key,
+    "codec": codec_id}``) + frame(pickled payload pytree), each frame
+    length+sha256 checked — for a quantized payload the second frame covers
+    BOTH the low-bit tensors and their scale tensors (they pickle as one
+    pytree), and the codec id in the first frame pins which codec wrote
+    them: reading a spill under a different codec config raises
+    :class:`KVCodecMismatch` instead of silently dequantizing wrong.
+    Pre-codec records (a bare pickled chain key) read as codec ``"off"``.
+    Writes
     follow the checkpoint commit protocol (PR 9): same-directory temp file,
     flush+fsync, atomic ``os.replace``, directory fsync — a crash can leave
     a temp file or a torn record, never a half-visible one, and
@@ -202,9 +218,11 @@ class DiskTier:
 
     SUFFIX = ".kvb"
 
-    def __init__(self, directory: str, budget_blocks: int = 0):
+    def __init__(self, directory: str, budget_blocks: int = 0,
+                 codec: str = "off"):
         self.directory = str(directory)
         self.budget_blocks = max(0, int(budget_blocks))
+        self.codec = str(codec)
         os.makedirs(self.directory, exist_ok=True)
         self.nbytes = 0
         self.sweep_removed = 0
@@ -291,7 +309,8 @@ class DiskTier:
         if digest in self._index:
             return True  # same chain key = same content: keep the old record
         body = (RECORD_MAGIC
-                + frame_bytes(pickle.dumps(key, protocol=4))
+                + frame_bytes(pickle.dumps({"key": key, "codec": self.codec},
+                                           protocol=4))
                 + frame_bytes(pickle.dumps(payload, protocol=4)))
         path = self._path(digest)
         tmp = os.path.join(self.directory,
@@ -328,7 +347,10 @@ class DiskTier:
         """Load one record's payload, or None. Every failure mode — missing
         file, torn frame, digest mismatch, or a digest collision where the
         stored exact key differs — reads as a miss, and a corrupt record is
-        unlinked so it cannot waste future lookups."""
+        unlinked so it cannot waste future lookups. The ONE exception is a
+        codec mismatch (record written under a different
+        ``RaggedConfig.quant``): that RAISES :class:`KVCodecMismatch` — the
+        record is intact, the configuration is wrong."""
         digest = _key_digest(key)
         if digest not in self._index:
             return None
@@ -339,11 +361,24 @@ class DiskTier:
             if not buf.startswith(RECORD_MAGIC):
                 raise ValueError("bad magic")
             key_body, off = unframe_bytes(buf, len(RECORD_MAGIC))
-            stored_key = pickle.loads(key_body)
+            stored = pickle.loads(key_body)
+            if isinstance(stored, dict) and "key" in stored:
+                stored_key = stored["key"]
+                stored_codec = stored.get("codec", "off")
+            else:  # pre-codec record: a bare pickled chain key
+                stored_key, stored_codec = stored, "off"
             if stored_key != key:
                 return None  # digest collision: a miss, never a wrong splice
+            if stored_codec != self.codec:
+                raise KVCodecMismatch(
+                    f"KV spill record {digest} was written under codec "
+                    f"{stored_codec!r} but this engine runs {self.codec!r} "
+                    "(RaggedConfig.quant); refusing to dequantize — clear "
+                    "the tier directory or match the codec config")
             payload_body, _ = unframe_bytes(buf, off)
             return pickle.loads(payload_body)
+        except KVCodecMismatch:
+            raise
         except (OSError, ValueError, pickle.UnpicklingError, EOFError):
             self.nbytes -= self._index.pop(digest, 0)
             self._unlink(path)
@@ -380,10 +415,11 @@ class KVTierStore:
                  directory: str = "runs/kvtier",
                  host_gbps: float = 100.0, disk_gbps: float = 8.0,
                  prefill_tokens_per_s: float = 50000.0,
-                 bytes_per_token: int = 0):
+                 bytes_per_token: int = 0, codec: str = "off"):
+        self.codec = str(codec)
         self.host = HostTier(host_blocks)
-        self.disk = DiskTier(directory, disk_blocks) if disk_blocks > 0 \
-            else None
+        self.disk = DiskTier(directory, disk_blocks, codec=self.codec) \
+            if disk_blocks > 0 else None
         self.host_gbps = float(host_gbps)
         self.disk_gbps = float(disk_gbps)
         self.prefill_tokens_per_s = float(prefill_tokens_per_s)
@@ -585,6 +621,7 @@ class KVTierStore:
                 "prefetch_hits": self.prefetch_hits,
                 "prefetch_abandoned": self.prefetch_abandoned,
                 "sweep_removed": self.sweep_removed,
+                "codec": self.codec,
             }
 
     @property

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference import kvquant
 from deepspeed_tpu.models.api import ModelSpec, ShardCtx
 from deepspeed_tpu.serving.faults import (
     POINT_ALLOC,
@@ -82,6 +83,7 @@ class BlockedAllocator:
         self._keys: dict[int, Any] = {}  # block id -> its chain key
         self._lru: dict[int, None] = {}  # refcount-0 published blocks, LRU->MRU
         self.evictions = 0  # cumulative cached blocks reclaimed under pressure
+        self.allocated_total = 0  # cumulative blocks handed out (all paths)
         # optional publish/evict listener (serving cluster prefix index):
         # an object with on_publish(key) / on_evict(key), called on the
         # engine thread as keys enter/leave the index. None = standalone.
@@ -119,6 +121,7 @@ class BlockedAllocator:
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._refs[b] = 1
+        self.allocated_total += n
         return out
 
     def _evict_lru(self) -> None:
@@ -347,6 +350,20 @@ class RaggedConfig:
     # while the request rides the queue, so the admission-time restore only
     # pays the host->device hop
     kv_tier_prefetch: bool = True
+    # ---- low-bit serving (inference/kvquant.py) ----
+    # ONE config surface for the full low-bit path, grammar
+    # "off" | "int8" | "fp8" | "woq8" | "woq4" | "qcol" joined with "+"
+    # (e.g. "int8+woq8"). The KV codec makes the *block* the unit of
+    # quantization everywhere a block lives — HBM pool, host/disk tiers,
+    # prefix-cache retained set, KVHandoff wire — quantized at write time,
+    # dequant fused into the jitted gather; ~2x resident blocks per HBM
+    # byte under a measured drift budget (kvquant.DRIFT_BUDGET). "woqN"
+    # is the weight-only path (same as the quantize_bits ctor arg);
+    # "qcol" quantizes the TP inference collectives (needs a mesh — the
+    # GSPMD-sharded InferenceEngine; inert on this single-host engine).
+    # Off by default: the default path is bit-identical to an engine
+    # that predates this knob (pinned by test).
+    quant: str = "off"
 
     @property
     def max_seq_len(self) -> int:
@@ -474,6 +491,11 @@ class KVHandoff:
     # W3C trace context of the originating request, so the decode replica
     # parents its spans under the same trace_id (fleet trace stitching)
     traceparent: str | None = None
+    # KV codec of block_payload ("off" = fp payload). A decode replica
+    # running a DIFFERENT codec config must reject the record
+    # (import_handoff raises; the cluster falls back to a cold submit)
+    # instead of scattering bytes it would dequantize wrong.
+    codec: str = "off"
 
     @property
     def n_blocks(self) -> int:
@@ -531,6 +553,9 @@ class PrefixPayload:
     block_payload: Any = None  # cache pytree, leaves [L, n_blocks, bs, ...]
     # trace context of the exporting request (cross-replica span links)
     traceparent: str | None = None
+    # KV codec of block_payload; a mismatched importer declines the splice
+    # (prefix reuse is an optimization — a miss, not an error)
+    codec: str = "off"
 
     @property
     def n_blocks(self) -> int:
@@ -569,18 +594,45 @@ class RaggedInferenceEngine:
             lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
             params,
         )
-        if quantize_bits:
+        # ---- low-bit serving (inference/kvquant.py) ----
+        # ONE config surface: cfg.quant carries the KV codec, the woq bits
+        # and the collective flag; the quantize_bits ctor arg stays as the
+        # back-compat spelling of the woq component.
+        parsed = kvquant.parse_quant(self.cfg.quant)
+        self._kvq = parsed.kv
+        self._kvq_name = parsed.kv.name if parsed.kv else "off"
+        if parsed.qcol:
+            # the quantized TP logits collective needs a mesh; this engine
+            # is GSPMD-free single-host — accepted so one quant string works
+            # across both engines, but inert here
+            log_dist("ragged engine: quant '+qcol' has no mesh here; "
+                     "ignored (see inference/engine.py)", ranks=[0])
+        woq_bits = int(quantize_bits) or parsed.woq_bits
+        if woq_bits:
             # weight-only quantization over the paged-KV engine (reference
             # inference/quantization WOQ composed with the v2 ragged engine)
             from deepspeed_tpu.ops.quantizer import quantize_params
 
             self.params = jax.jit(
-                lambda p: quantize_params(p, bits=int(quantize_bits),
+                lambda p: quantize_params(p, bits=woq_bits,
                                           skip=tuple(self.spec.woq_skip))
             )(self.params)
-        self.cache = self.spec.init_paged_cache_fn(
-            self.cfg.num_blocks, self.cfg.block_size, dtype
-        )
+        self.quantize_bits = woq_bits
+        self.cache = self._build_cache()
+        # bytes one block would cost unquantized at the engine dtype / at
+        # fp16: the baselines for kvquant_bytes_saved_total and the
+        # resident-block multiplier the bench gates on. The blocks base
+        # accumulates allocated_total of allocators retired by reset_state
+        # so the saved-bytes counter stays monotonic across containment.
+        self._kvq_blocks_allocated = 0
+        self._kvquant_saved_seen = 0
+        if self._kvq is not None:
+            self._fp_block_bytes = kvquant.paged_block_bytes(
+                self.spec.init_paged_cache_fn, self.cfg.num_blocks,
+                self.cfg.block_size, dtype)
+            self._fp16_block_bytes = kvquant.paged_block_bytes(
+                self.spec.init_paged_cache_fn, self.cfg.num_blocks,
+                self.cfg.block_size, jnp.float16)
         self.allocator = BlockedAllocator(self.cfg.num_blocks)
         # ---- hierarchical KV tiering (inference/kvtier.py) ----
         # tier store + allocator demote hook; None with kv_tier off, and
@@ -603,6 +655,7 @@ class RaggedInferenceEngine:
                 disk_gbps=self.cfg.kv_tier_disk_gbps,
                 prefill_tokens_per_s=self.cfg.kv_tier_prefill_tokens_per_s,
                 bytes_per_token=self.kv_bytes_per_token(),
+                codec=self._kvq_name,
             )
             self.allocator.demote_hook = self._demote_block
         # row max_seqs is the all-zeros padding row -> scratch block 0
@@ -1050,6 +1103,41 @@ class RaggedInferenceEngine:
                 self.kv_bytes_per_token() * self.cfg.block_size
         return self._kv_block_bytes
 
+    def _build_cache(self):
+        """Build the paged KV pool: the family's plain fp pool when quant is
+        off (bit-identical to the pre-quant engine), else the low-bit
+        ``QuantizedKV`` pool built from ``eval_shape`` (no transient fp
+        allocation at the full pool size)."""
+        if self._kvq is None:
+            return self.spec.init_paged_cache_fn(
+                self.cfg.num_blocks, self.cfg.block_size, self.dtype)
+        return kvquant.build_quantized_paged_cache(
+            self.spec.init_paged_cache_fn, self.cfg.num_blocks,
+            self.cfg.block_size, self.dtype, self._kvq)
+
+    def kv_quant_stats(self) -> dict | None:
+        """Low-bit KV summary for bench/telemetry readers; None = quant off.
+        ``resident_multiplier_vs_fp16`` is the blocks-per-HBM-byte gain the
+        acceptance bar measures (fp16 block bytes / quantized block bytes)."""
+        if self._kvq is None:
+            return None
+        bb = self._block_bytes()
+        return {
+            "codec": self._kvq_name,
+            "block_bytes": bb,
+            "fp16_block_bytes": self._fp16_block_bytes,
+            "fp_block_bytes": self._fp_block_bytes,
+            "resident_multiplier_vs_fp16": self._fp16_block_bytes / bb,
+            "blocks_allocated_total": self._kvq_alloc_total(),
+            "bytes_saved_total":
+                self._kvq_alloc_total() * (self._fp_block_bytes - bb),
+        }
+
+    def _kvq_alloc_total(self) -> int:
+        """Cumulative blocks allocated over the engine's lifetime (survives
+        reset_state's allocator replacement via the accumulated base)."""
+        return self._kvq_blocks_allocated + self.allocator.allocated_total
+
     # ------------------------------------------------------- memory ledger
     def _register_memory_owners(self) -> None:
         """Attribute this engine's long-lived device allocations to ledger
@@ -1303,7 +1391,8 @@ class RaggedInferenceEngine:
             deadline_remaining_s=rem, block_payload=payload,
             row_iv=iv, row_fv=fv,
             traceparent=(format_traceparent(seq.trace)
-                         if seq.trace is not None else None))
+                         if seq.trace is not None else None),
+            codec=self._kvq_name)
         if self.cfg.enable_prefix_cache:
             self._publish_prompt_blocks(seq)
         self.allocator.free(seq.blocks)
@@ -1336,6 +1425,14 @@ class RaggedInferenceEngine:
         requests this engine could never serve."""
         cfg = self.cfg
         bs = cfg.block_size
+        if getattr(h, "codec", "off") != self._kvq_name:
+            # scattering a payload quantized under a different codec would
+            # dequantize garbage (or splice int8 bytes as fp) — never
+            # servable here, so raise (the loop surfaces import_rejected
+            # and the cluster falls back to a cold submit)
+            raise ValueError(
+                f"handoff KV codec {getattr(h, 'codec', 'off')!r} does not "
+                f"match this engine's quant config {self._kvq_name!r}")
         prompt = [int(t) for t in h.prompt]
         total = len(prompt) + int(h.max_new_tokens)
         if total > cfg.max_seq_len:
@@ -1450,7 +1547,8 @@ class RaggedInferenceEngine:
             tokens=prompt[:len(hit) * self.cfg.block_size],
             block_payload=payload,
             traceparent=(format_traceparent(trace)
-                         if trace is not None else None))
+                         if trace is not None else None),
+            codec=self._kvq_name)
 
     def import_prefix(self, payload: PrefixPayload | None) -> int:
         """Install transferred prefix blocks into the local prefix cache
@@ -1460,6 +1558,11 @@ class RaggedInferenceEngine:
         now cached locally. Already-published chain links are kept (dedupe);
         imports past the unreserved budget are dropped, never forced."""
         if payload is None or not self.cfg.enable_prefix_cache:
+            return 0
+        if getattr(payload, "codec", "off") != self._kvq_name:
+            # prefix transfer is opportunistic — a codec mismatch is a
+            # graceful miss (the importer just prefills), unlike handoff
+            # adoption where mid-stream state makes it a hard error
             return 0
         t_imp0 = (time.perf_counter()
                   if self._tracer.enabled and payload.traceparent else 0.0)
@@ -4010,6 +4113,7 @@ class RaggedInferenceEngine:
         self._pending.clear()
         self._inflight_chunks.clear()
         self._staging_cache.clear()
+        self._kvq_blocks_allocated += self.allocator.allocated_total
         self.allocator = BlockedAllocator(self.cfg.num_blocks)
         if self._kvtier is not None:
             # the tier store SURVIVES reset: its records are keyed by exact
@@ -4039,8 +4143,7 @@ class RaggedInferenceEngine:
                           if self.cfg.spec_draft else None)
         self._hist_stale[:] = True
         self._sched_wait = False
-        self.cache = self.spec.init_paged_cache_fn(
-            self.cfg.num_blocks, self.cfg.block_size, self.dtype)
+        self.cache = self._build_cache()
         self._consec_failures = 0
         self._refresh_memory_handles()
         if failed:
@@ -4168,6 +4271,24 @@ class RaggedInferenceEngine:
                 if delta > 0:
                     tel.counter(f"kvtier_{name}_total", help_).inc(delta)
                     seen[name] = st[name]
+        g("kvquant_enabled",
+          "low-bit KV pool active (1 = quantized, 0 = fp pool)").set(
+              1.0 if self._kvq is not None else 0.0, codec=self._kvq_name)
+        if self._kvq is not None:
+            saved = self._kvq_alloc_total() \
+                * (self._fp_block_bytes - self._block_bytes())
+            delta = saved - self._kvquant_saved_seen
+            if delta > 0:
+                tel.counter(
+                    "kvquant_bytes_saved_total",
+                    "HBM bytes the low-bit pool saved vs the fp pool, "
+                    "accumulated over allocated blocks",
+                ).inc(delta, codec=self._kvq_name)
+                self._kvquant_saved_seen = saved
+            g("kvquant_block_multiplier",
+              "resident KV blocks per HBM byte vs an fp16 pool").set(
+                  self._fp16_block_bytes / max(1, self._block_bytes()),
+                  codec=self._kvq_name)
         hb = self.admission_headroom_blocks()
         if hb >= 0:
             g("kv_headroom_blocks",

@@ -16,10 +16,18 @@ from jax import lax
 
 def write_kv_paged(kc, vc, kk, vv, slots, positions, block_tables):
     """Scatter each ragged token's new KV into (block, offset) of its
-    sequence's pool blocks. ``kk``/``vv``: [T, Hkv, D]."""
+    sequence's pool blocks. ``kk``/``vv``: [T, Hkv, D].
+
+    This is the ONE write site of the paged contract, so it is also the
+    ONE quantize site: a low-bit pool (``inference/kvquant.QuantizedKV``)
+    quantizes each token row at write time — per-row scales keep the
+    incremental scatter exact (rewriting a row never re-rounds another).
+    """
     bs = kc.shape[1]
     blk = block_tables[slots, positions // bs]  # [T]
     off = positions % bs
+    if getattr(kc, "is_quantized_kv", False):
+        return kc.scatter_rows(blk, off, kk), vc.scatter_rows(blk, off, vv)
     kc = kc.at[blk, off].set(kk.astype(kc.dtype))
     vc = vc.at[blk, off].set(vv.astype(vc.dtype))
     return kc, vc

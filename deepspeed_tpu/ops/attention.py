@@ -117,7 +117,15 @@ def paged_attention(q, k_pool, v_pool, slots, positions, block_tables,
 
     impl="pallas": stream blocks through VMEM via the block table (no padded
     gather); impl="xla": gather the padded context (fallback / CPU tests).
+
+    A quantized pool (``inference/kvquant.QuantizedKV``) always takes the
+    XLA path: the gather+dequant fuse into one program there (the fp
+    context is a per-dispatch transient). A Pallas kernel that streams
+    int8 blocks + scales through VMEM is the TPU drop-in point — it slots
+    in at this dispatch without touching callers.
     """
+    if getattr(k_pool, "is_quantized_kv", False):
+        impl = "xla"
     if impl == "auto":
         import os
 
@@ -162,8 +170,16 @@ def paged_attention(q, k_pool, v_pool, slots, positions, block_tables,
     t_tokens, hq, d = q.shape
     hkv = k_pool.shape[2]
     tables = block_tables[slots]                       # [T, MB]
-    ctx_k = repeat_kv(k_pool[tables].reshape(t_tokens, -1, hkv, d), hq // hkv)
-    ctx_v = repeat_kv(v_pool[tables].reshape(t_tokens, -1, hkv, d), hq // hkv)
+    if getattr(k_pool, "is_quantized_kv", False):
+        ctx_k = repeat_kv(k_pool.gather_dequant(tables)
+                          .reshape(t_tokens, -1, hkv, d), hq // hkv)
+        ctx_v = repeat_kv(v_pool.gather_dequant(tables)
+                          .reshape(t_tokens, -1, hkv, d), hq // hkv)
+    else:
+        ctx_k = repeat_kv(k_pool[tables].reshape(t_tokens, -1, hkv, d),
+                          hq // hkv)
+        ctx_v = repeat_kv(v_pool[tables].reshape(t_tokens, -1, hkv, d),
+                          hq // hkv)
     scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.float32(d))
     k_pos = jnp.arange(ctx_k.shape[1])
     bias = jnp.where(k_pos[None, :] <= positions[:, None], 0.0, -1e30)
@@ -185,6 +201,8 @@ def ragged_prefill_attention(q, k_pool, v_pool, tile_slot, tile_pos0,
     fallback expands the tile metadata to per-token (slot, position) arrays
     and reuses the padded-gather path.
     """
+    if getattr(k_pool, "is_quantized_kv", False):
+        impl = "xla"  # fused gather+dequant (see paged_attention)
     if impl == "auto":
         impl = "pallas" if _on_tpu() else "xla"
     if impl == "pallas":

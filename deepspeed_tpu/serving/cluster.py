@@ -100,7 +100,14 @@ def transfer_beats_prefill(tokens: int, bytes_per_token: int,
     wire time undercuts re-running prefill for those tokens. Conservative
     on unknowns: an unreported bandwidth or prefill rate (-1/0) must never
     transfer — a negative divisor would flip the inequality and claim a
-    free wire."""
+    free wire.
+
+    ``bytes_per_token`` comes from the holder engine's
+    ``kv_bytes_per_token()``, measured over its actual cache pytree — with
+    low-bit KV (``RaggedConfig.quant``, inference/kvquant.py) that is the
+    quantized payload + scale bytes, so a ~2x smaller wire cost shifts this
+    inequality toward transferring exactly as it should (and codec-matched
+    import is enforced at the importer, not here)."""
     if tokens <= 0 or cfg.transfer_gbps <= 0 or cfg.prefill_tokens_per_s <= 0:
         return False
     wire_s = tokens * bytes_per_token * 8.0 / (cfg.transfer_gbps * 1e9)

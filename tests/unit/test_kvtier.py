@@ -13,6 +13,7 @@ notify-before-free ordering the cluster index depends on.
 """
 
 import os
+import pickle
 import threading
 
 import jax.numpy as jnp
@@ -22,8 +23,10 @@ import pytest
 from deepspeed_tpu.inference.kvtier import (
     DiskTier,
     HostTier,
+    KVCodecMismatch,
     KVTierStore,
     RECORD_MAGIC,
+    _key_digest,
     frame_bytes,
     restore_beats_prefill,
     unframe_bytes,
@@ -219,6 +222,53 @@ class TestDiskTier:
         assert d2.sweep_removed == 3
         assert sorted(os.listdir(root)) == [os.path.basename(valid)]
         assert d2.get(good) is not None
+
+    def test_codec_recorded_and_matched(self, tmp_path):
+        root = str(tmp_path / "kv")
+        key = (None, (1, 2, 3, 4))
+        d = DiskTier(root, budget_blocks=8, codec="int8")
+        d.put(key, {"q": np.zeros(4, np.int8), "s": np.ones(1, np.float16)})
+        got = DiskTier(root, budget_blocks=8, codec="int8").get(key)
+        assert got is not None and got["q"].dtype == np.int8
+
+    def test_codec_mismatch_raises_not_misses(self, tmp_path):
+        # a spill written under int8 read by an fp16/off engine is a CONFIG
+        # error: silently dequantizing (or splicing raw int8 as fp rows)
+        # would corrupt tokens, so get() must raise, never return None
+        root = str(tmp_path / "kv")
+        key = (None, (1, 2, 3, 4))
+        DiskTier(root, budget_blocks=8, codec="int8").put(key, np.zeros(4))
+        for other in ("off", "fp8"):
+            reader = DiskTier(root, budget_blocks=8, codec=other)
+            with pytest.raises(KVCodecMismatch, match="int8"):
+                reader.get(key)
+            # the record is intact, not a casualty: the matching engine
+            # still reads it afterwards
+            assert DiskTier(root, budget_blocks=8,
+                            codec="int8").get(key) is not None
+
+    def test_legacy_bare_key_record_reads_as_off(self, tmp_path):
+        # records written before codec framing carry a bare pickled chain
+        # key: they read fine under codec "off" and raise under a quant one
+        root = str(tmp_path / "kv")
+        os.makedirs(root)
+        key = (None, (7, 8, 9))
+        body = (RECORD_MAGIC
+                + frame_bytes(pickle.dumps(key, protocol=4))
+                + frame_bytes(pickle.dumps(np.arange(4), protocol=4)))
+        with open(os.path.join(root, _key_digest(key) + DiskTier.SUFFIX),
+                  "wb") as f:
+            f.write(body)
+        got = DiskTier(root, budget_blocks=8, codec="off").get(key)
+        np.testing.assert_array_equal(got, np.arange(4))
+        with pytest.raises(KVCodecMismatch):
+            DiskTier(root, budget_blocks=8, codec="int8").get(key)
+
+    def test_store_threads_codec_to_disk_and_stats(self, tmp_path):
+        st = KVTierStore(host_blocks=2, disk_blocks=4,
+                         directory=str(tmp_path / "kv"), codec="fp8")
+        assert st.disk.codec == "fp8"
+        assert st.stats()["codec"] == "fp8"
 
 
 # ---------------------------------------------- allocator demotion ordering

@@ -189,3 +189,31 @@ def test_ragged_engine_woq():
     out = eng.generate_all()
     assert len(out["a"]) == 4 and len(out["b"]) == 4
     assert all(0 <= t < VOCAB for t in out["a"] + out["b"])
+
+
+def test_quant_string_surface_equals_quantize_bits():
+    """`quant="woq8"` (the kvquant one-config-surface grammar) must be the
+    SAME engine as the legacy `quantize_bits=8` ctor arg, on both the dense
+    and the init_inference config paths."""
+    reset_topology()
+    cfg = llama.LlamaConfig.tiny(VOCAB)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    a = InferenceEngine(lambda ctx: llama.build(cfg, ctx=ctx),
+                        params=params, quant="woq8")
+    b = InferenceEngine(lambda ctx: llama.build(cfg, ctx=ctx),
+                        params=params, quantize_bits=8)
+    assert a.quantize_bits == b.quantize_bits == 8
+    prompt = np.arange(8)[None]
+    np.testing.assert_array_equal(
+        np.asarray(a.generate(prompt, max_new_tokens=4)),
+        np.asarray(b.generate(prompt, max_new_tokens=4)))
+    # the string form rides through the reference-style config dict too
+    eng = init_inference(
+        lambda ctx: llama.build(cfg, ctx=ctx),
+        config={"quant": "woq4", "params": params})
+    assert eng.quantize_bits == 4
+    # a KV codec component is inert on the dense engine (paged-only), not
+    # an error: one grammar, each engine takes the parts that apply
+    eng2 = InferenceEngine(lambda ctx: llama.build(cfg, ctx=ctx),
+                           params=params, quant="int8+woq8")
+    assert eng2.quantize_bits == 8
