@@ -707,6 +707,7 @@ def train_anatomy_main():
     """
     import tempfile
 
+    import jax
     import numpy as np
 
     import deepspeed_tpu
@@ -724,9 +725,11 @@ def train_anatomy_main():
         max_seq_len=int(e.get("BENCH_ANATOMY_SEQ", 128)),
     )
     seq = int(e.get("BENCH_ANATOMY_SEQ", 128))
-    batch = int(e.get("BENCH_ANATOMY_BATCH", 8))
     steps = int(e.get("BENCH_ANATOMY_STEPS", 8))
     gas = int(e.get("BENCH_ANATOMY_GAS", 2))
+    # default batch covers gas x dp (8 simulated devices on the CPU backend)
+    batch = int(e.get("BENCH_ANATOMY_BATCH",
+                      max(8, gas * jax.device_count())))
     # device-capture window every N steps (0 disables); the default lands
     # one window inside the default step budget, past warmup/compile
     profile_interval = int(e.get("BENCH_ANATOMY_PROFILE_INTERVAL", 4))
@@ -752,31 +755,90 @@ def train_anatomy_main():
             },
         },
     }
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=lambda ctx: llama.build(model_cfg, ctx=ctx), config=config)
+    def run_leg(overlap_on: bool, checkpoint: bool = False):
+        """One training leg: identical data/seed, grad_overlap toggled.
 
-    rng = np.random.default_rng(0)
+        Returns the stepscope summary, devprof capture, final params and the
+        per-leg overlap gauges — the off leg is the fused baseline the on
+        leg's parity and latency-hiding claims are measured against."""
+        from deepspeed_tpu.comm.topology import reset_topology
 
-    def data_iter():
-        while True:
-            yield {"input_ids": rng.integers(
-                0, model_cfg.vocab_size,
-                (batch // gas, seq), dtype=np.int32)}
+        reset_topology()
+        # fresh trace ring + registry per leg: the exported trace and the
+        # scrape asserts below see only the on leg's spans/gauges
+        TELEMETRY.reset()
+        leg_cfg = json.loads(json.dumps(config))
+        # the overlap path needs a data axis to reduce over; single-device
+        # runs degrade to an off-vs-off A/B (parity trivially exact)
+        if overlap_on and jax.device_count() > 1:
+            leg_cfg["zero_optimization"]["grad_overlap"] = {
+                "enabled": True,
+                "bucket_bytes": int(e.get("BENCH_ANATOMY_BUCKET_BYTES",
+                                          4 << 20)),
+            }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=lambda ctx: llama.build(model_cfg, ctx=ctx), config=leg_cfg)
 
-    it = data_iter()
-    for _ in range(steps):
-        engine.train_batch(data_iter=it)
-    # one checkpoint save so the goodput ledger has a checkpoint entry
-    with tempfile.TemporaryDirectory() as ckpt_dir:
-        engine.save_checkpoint(ckpt_dir)
-    summary = engine.stepscope.summary()
+        rng = np.random.default_rng(0)
 
-    # measured-vs-estimated overlap: the estimate comes from stepscope's
-    # analytic wire-time model, the measured value from the devprof capture
-    # window's classified device timeline (None when no window completed)
-    devprof_last = engine.devprof_last
-    devprof_summary = (devprof_last or {}).get("summary")
-    measured_overlap = (devprof_summary or {}).get("overlap_fraction_measured")
+        def data_iter():
+            while True:
+                yield {"input_ids": rng.integers(
+                    0, model_cfg.vocab_size,
+                    (batch // gas, seq), dtype=np.int32)}
+
+        it = data_iter()
+        for _ in range(steps):
+            engine.train_batch(data_iter=it)
+        if checkpoint:
+            # one checkpoint save so the goodput ledger has a checkpoint entry
+            with tempfile.TemporaryDirectory() as ckpt_dir:
+                engine.save_checkpoint(ckpt_dir)
+        summary = engine.stepscope.summary()
+        devprof_last = engine.devprof_last
+        devprof_summary = (devprof_last or {}).get("summary")
+        phase_totals = summary.get("phase_seconds_total") or {}
+        total_phase = sum(phase_totals.values()) or 1.0
+        leg = {
+            "summary": summary,
+            "devprof_last": devprof_last,
+            "devprof_summary": devprof_summary,
+            "params": jax.tree_util.tree_map(np.asarray, engine.params),
+            "overlap_fraction_estimate": summary.get("overlap_fraction"),
+            "overlap_fraction_measured":
+                (devprof_summary or {}).get("overlap_fraction_measured"),
+            # ZeRO-1 sharded update: the optimizer phase share should SHRINK
+            # on the on leg (each rank updates 1/dp of every bucket)
+            "optimizer_phase_share":
+                phase_totals.get("optimizer", 0.0) / total_phase,
+            # per-bucket wire time: the devprof families feeding the
+            # devprof_collective_seconds{op=} histogram
+            "collective_wire": [
+                {"op": c.get("op"), "seconds": c.get("seconds"),
+                 "count": c.get("count")}
+                for c in (devprof_summary or {}).get("collectives", [])],
+        }
+        return engine, leg
+
+    # leg A: fused baseline (overlap off); leg B: bucketed async overlap.
+    # Same seed, same data stream — leg B's params must stay inside the
+    # documented fp-reorder bound of leg A's.
+    off_engine, leg_off = run_leg(overlap_on=False)
+    off_engine.destroy()
+    engine, leg_on = run_leg(overlap_on=True, checkpoint=True)
+
+    parity_drift = max(
+        float(np.max(np.abs(a - b)))
+        for a, b in zip(jax.tree_util.tree_leaves(leg_off["params"]),
+                        jax.tree_util.tree_leaves(leg_on["params"])))
+    # documented fp-reorder bound (ring sum order + local-mean-then-pmean;
+    # docs/TP_OVERLAP.md "grad-sync overlap") at bf16 compute precision
+    parity_ok = parity_drift < float(e.get("BENCH_ANATOMY_PARITY_TOL", 5e-3))
+
+    summary = leg_on["summary"]
+    devprof_last = leg_on["devprof_last"]
+    devprof_summary = leg_on["devprof_summary"]
+    measured_overlap = leg_on["overlap_fraction_measured"]
 
     trace_path = os.path.join(runs_dir, "BENCH_train_anatomy_trace.json")
     trace = TELEMETRY.dump_trace(trace_path)
@@ -804,6 +866,29 @@ def train_anatomy_main():
         "gas": gas,
         "overlap_fraction_estimate": summary.get("overlap_fraction"),
         "overlap_fraction_measured": measured_overlap,
+        # A/B overlap anatomy: fused baseline (off) vs bucketed async
+        # grad collectives + sharded update (on), same seed and data
+        "overlap": {
+            "enabled": jax.device_count() > 1,
+            "parity_max_drift": parity_drift,
+            "parity_ok": parity_ok,
+            "off": {
+                "overlap_fraction_estimate":
+                    leg_off["overlap_fraction_estimate"],
+                "overlap_fraction_measured":
+                    leg_off["overlap_fraction_measured"],
+                "optimizer_phase_share": leg_off["optimizer_phase_share"],
+                "collective_wire": leg_off["collective_wire"],
+            },
+            "on": {
+                "overlap_fraction_estimate":
+                    leg_on["overlap_fraction_estimate"],
+                "overlap_fraction_measured":
+                    leg_on["overlap_fraction_measured"],
+                "optimizer_phase_share": leg_on["optimizer_phase_share"],
+                "collective_wire": leg_on["collective_wire"],
+            },
+        },
         "devprof": {
             "enabled": profile_interval > 0,
             "summary": devprof_summary,
@@ -830,7 +915,17 @@ def train_anatomy_main():
 
 
 def run_train_anatomy_subprocess(timeout: float = 900.0):
-    return _run_flagged_subprocess("BENCH_TRAIN_ANATOMY", timeout)
+    # the overlap A/B needs a data axis: on the CPU backend simulate the
+    # 8-device mesh (tests/conftest.py's strategy); real accelerators keep
+    # their native device count
+    extra = None
+    flags = os.environ.get("XLA_FLAGS", "")
+    if (os.environ.get("JAX_PLATFORMS") == "cpu"
+            and "xla_force_host_platform_device_count" not in flags):
+        extra = {"XLA_FLAGS":
+                 (flags + " --xla_force_host_platform_device_count=8").strip()}
+    return _run_flagged_subprocess("BENCH_TRAIN_ANATOMY", timeout,
+                                   extra_env=extra)
 
 
 def infinity_trial_main():
@@ -991,12 +1086,15 @@ def learn_trial_main():
     }))
 
 
-def _run_flagged_subprocess(env_flag: str, timeout: float = 900.0):
+def _run_flagged_subprocess(env_flag: str, timeout: float = 900.0,
+                            extra_env: dict | None = None):
     """Re-exec this file with ``env_flag=1`` and parse the trailing JSON line
     (the serve/learn trial pattern; run_trial_subprocess builds its env from
     shape vars so it stays separate)."""
     env = dict(os.environ)
     env[env_flag] = "1"
+    if extra_env:
+        env.update(extra_env)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

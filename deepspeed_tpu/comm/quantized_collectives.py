@@ -31,6 +31,7 @@ from deepspeed_tpu.ops.quantizer import (
     quantize,
     quantize_signs,
 )
+from deepspeed_tpu.utils.compat import axis_size_compat, shard_map_compat
 
 SUPPORTED_WIRE_BITS = (1, 4, 8)
 
@@ -92,7 +93,7 @@ def quantized_all_reduce(x, axis_name: str, error=None, bits: int = 8,
     scaled by the axis size so the *mean* converges.
     """
     _check_bits(bits)
-    n = lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     my = lax.axis_index(axis_name)
     shape = x.shape
     xf = x.astype(jnp.float32)
@@ -143,7 +144,7 @@ def loco_quantized_all_reduce(x, axis_name: str, error_local=None,
     has the owner-segment shape: ``ceil(x.size / n)`` padded elements.
     """
     _check_bits(bits)
-    n = lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     shape = x.shape
     xf = x.astype(jnp.float32)
     if error_local is not None:
@@ -191,7 +192,7 @@ def loco_quantized_all_reduce_arrays(x, error_local, error_server, mesh,
             xs[0], axis_name, el[0], es[0], bits=bits, block=block)
         return mean[None], nel[None], nes[None]
 
-    return jax.shard_map(
+    return shard_map_compat(
         body, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=(P(None), spec, spec),
@@ -212,7 +213,7 @@ def quantized_all_reduce_arrays(x, error, mesh, axis_name: str,
         return mean[None], new_e[None]
 
     out_mean_spec = P(None)
-    return jax.shard_map(
+    return shard_map_compat(
         body, mesh=mesh,
         in_specs=(spec_x, spec_x),
         out_specs=(out_mean_spec, spec_x),

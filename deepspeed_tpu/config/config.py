@@ -189,6 +189,36 @@ class ZenFlowConfig(ConfigBase):
 
 
 @dataclass
+class GradOverlapConfig(ConfigBase):
+    """Overlap-first data-parallel backward (parallel/grad_overlap.py).
+
+    Partitions the grad tree into size-targeted buckets and reduces each as
+    an async ppermute ring inside a shard_map manual region, so later layers'
+    backward compute fills earlier buckets' transfer windows (docs/
+    TP_OVERLAP.md, "grad-sync overlap"). Off by default; when off the engine
+    builds exactly the fused baseline program.
+    """
+
+    enabled: bool = False
+    # target bucket payload in bytes (fp32 accumulation); rounded DOWN to a
+    # power of two at planning time
+    bucket_bytes: int = 4 * 2**20
+    # ZeRO-1-without-fsdp-axis: each data rank updates only its reduce-
+    # scattered grad shard, then all-gathers updated params — optimizer FLOPs
+    # and state-touch bytes drop by 1/dp
+    sharded_update: bool = True
+    # exactness kill switch: route the step through the fused baseline
+    # program (bit-identical by construction) while keeping the config
+    # surface — for A/B-ing the documented fp-reorder of the ring reduction
+    exact: bool = False
+
+    def _validate(self, path: str = "") -> None:
+        if self.bucket_bytes < 256:
+            raise ConfigError(
+                f"{path}bucket_bytes: must be >= 256, got {self.bucket_bytes}")
+
+
+@dataclass
 class ZeroConfig(ConfigBase):
     """ZeRO stages as sharding policy (reference: ``runtime/zero/config.py:401``).
 
@@ -241,6 +271,9 @@ class ZeroConfig(ConfigBase):
     # (mics_hierarchical_params_gather) is what XLA's topology-aware
     # collective lowering does by construction. 0 = off.
     mics_shard_size: int = 0
+    # Overlap-first DP backward: bucketed async grad rings + optional
+    # cross-replica sharded weight update (parallel/grad_overlap.py).
+    grad_overlap: GradOverlapConfig = field(default_factory=GradOverlapConfig)
 
     def _validate(self, path: str = "") -> None:
         if self.stage not in (0, 1, 2, 3):

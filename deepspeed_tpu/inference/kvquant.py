@@ -319,15 +319,11 @@ def quantized_logits_all_gather(x: jnp.ndarray, mesh, axis: str = "tensor"):
         sg = jax.lax.all_gather(s, axis, axis=gather_dim, tiled=True)
         return dequantize_rows(qg, sg, x.dtype, block=local)
 
-    try:  # jax >= 0.6 spelling
-        mapped = jax.shard_map(
-            body, mesh=mesh, in_specs=(spec_in,), out_specs=P(),
-            axis_names={axis}, check_vma=False)
-    except AttributeError:  # pre-0.6: the experimental module
-        from jax.experimental.shard_map import shard_map as _shard_map
+    from deepspeed_tpu.utils.compat import shard_map_compat
 
-        mapped = _shard_map(body, mesh=mesh, in_specs=(spec_in,),
-                            out_specs=P(), check_rep=False)
+    mapped = shard_map_compat(body, mesh=mesh, in_specs=(spec_in,),
+                              out_specs=P(), axis_names={axis},
+                              check_vma=False)
     return mapped(x)
 
 

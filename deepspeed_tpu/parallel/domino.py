@@ -37,12 +37,13 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.topology import AXIS_TENSOR
+from deepspeed_tpu.utils.compat import axis_size_compat, shard_map_compat
 
 
 def ring_all_reduce(x, axis_name: str):
     """Sum-allreduce as n-1 async ppermute hops (collective-permute lowers to
     start/done pairs on TPU — overlappable; sync ``all-reduce`` is not)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     if n == 1:
         return x
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -73,7 +74,7 @@ def domino_apply(partial_fn: Callable, x, weights: Sequence,
         outs = [ring_all_reduce(partial_fn(c, *ws), axis) for c in chunks]
         return jnp.concatenate(outs, axis=0)
 
-    return jax.shard_map(
+    return shard_map_compat(
         local, mesh=mesh,
         in_specs=(P(),) + tuple(weight_specs),
         out_specs=P(),

@@ -1,0 +1,308 @@
+"""Overlap-first data-parallel backward: bucketed async gradient collectives.
+
+The committed TP-overlap finding (docs/TP_OVERLAP.md findings 1-4) showed that
+GSPMD's gradient all-reduce lowers *synchronously* on the v5e target — one
+fused reduction over the whole grad tree, dependent on every leaf, with
+nothing for the latency-hiding scheduler to move — while ``collective-permute``
+rings lower to async ``-start/-done`` pairs with independent fusions scheduled
+inside the transfer windows.
+
+This module is the gradient-sync half of that consequence (T3-style
+fine-grained overlap, arxiv 2401.16677): partition the grad tree into
+size-targeted buckets and reduce each bucket with its own ppermute ring inside
+a ``shard_map`` manual region over the data axis.  Each bucket's ring depends
+only on that bucket's grad leaves — NOT on the full tree — so XLA is free to
+issue bucket k's transfer while the backward is still producing bucket k+1's
+grads (the backward walks last-layer-first; path-ordered buckets put the
+early-produced grads in late buckets, and the scheduler fills the windows
+either way because the rings carry no cross-bucket dependency).
+
+The bucket plan is deterministic: leaves are keyed and ordered by their pytree
+key-path, so the same param tree always yields the same assignment — across
+processes and across restarts — which is what lets the ZeRO-1 flat optimizer
+state (`runtime/engine.py _init_overlap_opt_state`) survive checkpoint/resume.
+
+Numerics: the ring reduce-scatter accumulates each chunk's contributions in
+ring order (rank r's chunk sums contributions in the order r+1, r+2, ..., r).
+For dp=2 this is bit-identical to any all-reduce (two-term fp addition is
+commutative); for dp>2 it is a documented fp-reordering of the same exact sum
+— bounded, not approximate.  The exactness kill switch
+(``zero_optimization.grad_overlap.exact``) routes the engine back through the
+fused baseline program, which is bit-identical by construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deepspeed_tpu.utils.compat import shard_map_compat  # noqa: F401 (re-export)
+
+__all__ = [
+    "Bucket",
+    "BucketPlan",
+    "shard_map_compat",
+    "plan_buckets",
+    "ordered_leaves",
+    "pack_bucket",
+    "unpack_bucket",
+    "unflatten_buckets",
+    "local_shard",
+    "ring_reduce_scatter_sum",
+    "ring_all_gather",
+    "wire_bytes_per_element",
+]
+
+# flat bucket lengths pad to a multiple of dp * _PAD so every rank's shard is
+# lane-aligned; the waste is bounded by dp * _PAD * 4 bytes per bucket
+_PAD = 128
+
+# qgZ blockwise codec geometry (comm/quantized_collectives.py default block):
+# each quantized wire stage carries one fp32 scale per block of elements
+_QGZ_BLOCK = 64
+
+
+def wire_bytes_per_element(codec: str, block: int = _QGZ_BLOCK) -> float:
+    """Wire bytes one gradient element costs under the reduction codec.
+
+    ``fp32`` is the dense 4 B/elem wire.  ``int8``/``int4``/``int1`` are the
+    qgZ quantized wires: payload bits plus the per-block fp32 scales of the
+    two quantized stages (all-to-all reduce + all-gather re-broadcast).
+    """
+    if codec == "fp32":
+        return 4.0
+    if not codec.startswith("int"):
+        raise ValueError(f"unknown reduction codec {codec!r}")
+    bits = int(codec[3:])
+    return bits / 8.0 + 2 * 4.0 / block
+
+
+@dataclass(frozen=True)
+class BucketLeaf:
+    """One grad leaf's slot inside a bucket."""
+
+    path: str          # rendered pytree key-path (the deterministic sort key)
+    pos: int           # index into the plan's path-ordered leaf list
+    shape: tuple       # leaf shape
+    size: int          # element count
+    offset: int        # flat offset inside the bucket
+
+
+@dataclass(frozen=True)
+class Bucket:
+    index: int
+    leaves: tuple      # tuple[BucketLeaf]
+    elems: int         # payload elements (sum of leaf sizes)
+    padded: int        # flat length after dp*_PAD alignment
+    shard: int         # padded // dp — one rank's slice
+    codec: str         # "fp32" or "int{bits}" (qgZ)
+    wire_bytes: int    # per-step ring reduce wire bytes for this bucket
+
+
+@dataclass(frozen=True)
+class BucketPlan:
+    buckets: tuple     # tuple[Bucket]
+    paths: tuple       # tuple[str], path-ordered
+    order: tuple       # order[j] = original flatten position of ordered leaf j
+    dp: int
+    target_bytes: int  # the pow2-capped effective target
+    codec: str
+
+    @property
+    def total_elems(self) -> int:
+        return sum(b.elems for b in self.buckets)
+
+    def describe(self) -> str:
+        sizes = [b.elems * 4 for b in self.buckets]
+        return (f"{len(self.buckets)} buckets over {len(self.paths)} leaves, "
+                f"target {self.target_bytes} B (pow2-capped), "
+                f"sizes {min(sizes)}..{max(sizes)} B, codec {self.codec}")
+
+
+def _pow2_cap(target_bytes: int) -> int:
+    """Round the requested bucket size down to a power of two, so nearby
+    config values collapse to the same plan and the ring chunk sizes stay
+    friendly to the DMA engines."""
+    if target_bytes < 1:
+        raise ValueError(f"bucket target must be positive, got {target_bytes}")
+    return 1 << (int(target_bytes).bit_length() - 1)
+
+
+def plan_buckets(tree, dp: int, target_bytes: int,
+                 codec: str = "fp32") -> BucketPlan:
+    """Partition a grad/param tree into size-targeted buckets.
+
+    Deterministic by construction: leaves are sorted by their rendered pytree
+    key-path (a pure function of the tree structure — independent of dict
+    insertion order, process, or restart) and packed greedily in that order
+    into buckets capped at the pow2-floored ``target_bytes``.  An oversized
+    leaf gets a bucket of its own rather than splitting (leaf boundaries keep
+    unpacking trivial and the plan stable under small model edits).
+    """
+    if dp < 1:
+        raise ValueError(f"dp must be >= 1, got {dp}")
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+    if not leaves_with_path:
+        raise ValueError("cannot plan buckets over an empty tree")
+    rendered = []
+    for orig_pos, (path, leaf) in enumerate(leaves_with_path):
+        dt = getattr(leaf, "dtype", None)
+        if dt is None or not jnp.issubdtype(dt, jnp.floating):
+            raise ValueError(
+                f"grad_overlap buckets hold float leaves only; "
+                f"{jax.tree_util.keystr(path)} has dtype {dt}")
+        rendered.append((jax.tree_util.keystr(path), orig_pos,
+                         tuple(leaf.shape)))
+    rendered.sort(key=lambda r: r[0])
+
+    target = _pow2_cap(int(target_bytes))
+    pad_quantum = dp * _PAD
+    buckets = []
+    cur: list[BucketLeaf] = []
+    cur_bytes = 0
+
+    def close():
+        nonlocal cur, cur_bytes
+        if not cur:
+            return
+        elems = sum(l.size for l in cur)
+        padded = -(-elems // pad_quantum) * pad_quantum
+        wire = int(wire_bytes_per_element(codec) * padded * (dp - 1)
+                   / max(dp, 1))
+        buckets.append(Bucket(
+            index=len(buckets), leaves=tuple(cur), elems=elems,
+            padded=padded, shard=padded // dp, codec=codec, wire_bytes=wire))
+        cur, cur_bytes = [], 0
+
+    for j, (path, orig_pos, shape) in enumerate(rendered):
+        size = int(np.prod(shape)) if shape else 1
+        nbytes = 4 * size  # grads accumulate fp32
+        if cur and cur_bytes + nbytes > target:
+            close()
+        cur.append(BucketLeaf(path=path, pos=j, shape=shape, size=size,
+                              offset=sum(l.size for l in cur)))
+        cur_bytes += nbytes
+        if cur_bytes >= target:
+            close()
+    close()
+
+    return BucketPlan(
+        buckets=tuple(buckets),
+        paths=tuple(r[0] for r in rendered),
+        order=tuple(r[1] for r in rendered),
+        dp=dp, target_bytes=target, codec=codec)
+
+
+def ordered_leaves(tree, plan: BucketPlan):
+    """Flatten ``tree`` into the plan's path order. Returns (leaves, treedef)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if len(leaves) != len(plan.order):
+        raise ValueError(
+            f"tree has {len(leaves)} leaves; plan was built over "
+            f"{len(plan.order)}")
+    # order[j] is the flatten position of ordered leaf j — flatten order is
+    # itself deterministic, so this indexing IS the path sort
+    return [leaves[i] for i in plan.order], treedef
+
+
+def pack_bucket(leaves, bucket: Bucket) -> jnp.ndarray:
+    """Concatenate a bucket's (path-ordered) leaves into one padded fp32 flat
+    vector. ``leaves`` is the full ordered leaf list from ``ordered_leaves``."""
+    parts = [leaves[l.pos].reshape(-1).astype(jnp.float32)
+             for l in bucket.leaves]
+    pad = bucket.padded - bucket.elems
+    if pad:
+        parts.append(jnp.zeros((pad,), jnp.float32))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def unpack_bucket(flat: jnp.ndarray, bucket: Bucket, dtypes=None):
+    """Invert ``pack_bucket``: slice the flat vector back into leaf arrays
+    (static offsets — no gather). ``dtypes``: optional per-leaf target dtypes
+    keyed by the leaf's ordered position."""
+    out = []
+    for l in bucket.leaves:
+        x = lax.slice(flat, (l.offset,), (l.offset + l.size,)).reshape(l.shape)
+        if dtypes is not None:
+            x = x.astype(dtypes[l.pos])
+        out.append((l.pos, x))
+    return out
+
+
+def unflatten_buckets(flats, plan: BucketPlan, treedef, dtypes=None):
+    """Rebuild the original tree from per-bucket flat vectors."""
+    ordered = [None] * len(plan.order)
+    for flat, b in zip(flats, plan.buckets):
+        for pos, x in unpack_bucket(flat, b, dtypes=dtypes):
+            ordered[pos] = x
+    orig = [None] * len(plan.order)
+    for j, i in enumerate(plan.order):
+        orig[i] = ordered[j]
+    return jax.tree_util.tree_unflatten(treedef, orig)
+
+
+def local_shard(flat: jnp.ndarray, axis_name: str, n: int) -> jnp.ndarray:
+    """This rank's 1/n slice of a (replicated-value) flat bucket."""
+    if n == 1:
+        return flat
+    shard = flat.shape[0] // n
+    r = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(flat, r * shard, shard)
+
+
+def ring_reduce_scatter_sum(flat: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Ring reduce-scatter over ``axis_name``: rank r returns the fully
+    summed chunk r of ``flat`` (length ``flat.size // n``).
+
+    n-1 ppermute hops, each moving one chunk per rank — the bandwidth-optimal
+    (n-1)/n wire — and each hop's add is independent per bucket, which is what
+    lets the TPU scheduler run the hops as async collective-permute-start/done
+    pairs under unrelated backward compute (docs/TP_OVERLAP.md finding 4).
+
+    The message destined for chunk r starts at rank r+1 and walks the ring
+    picking up every rank's contribution; contributions therefore sum in ring
+    order (r+1, r+2, ..., r).  Exact for dp=2 (two-term fp addition is
+    commutative); an fp reorder of the same sum for dp>2.
+    """
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return flat
+    if flat.shape[0] % n:
+        raise ValueError(
+            f"flat length {flat.shape[0]} not divisible by ring size {n}")
+    r = lax.axis_index(axis_name)
+    chunks = flat.reshape(n, -1)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # my message starts as my contribution to chunk (r - 1) mod n — the chunk
+    # that is n-1 hops downstream of me
+    acc = jnp.take(chunks, (r - 1) % n, axis=0)
+    for h in range(1, n):
+        acc = lax.ppermute(acc, axis_name, perm)
+        # after hop h the message at rank r is destined for chunk (r - h - 1)
+        # mod n; add my local contribution to that chunk
+        acc = acc + jnp.take(chunks, (r - h - 1) % n, axis=0)
+    return acc
+
+
+def ring_all_gather(shard: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Ring all-gather over ``axis_name``: every rank returns the rank-ordered
+    concatenation [shard_0, ..., shard_{n-1}] (flat). Same async ppermute
+    lowering as the reduce-scatter; (n-1)/n wire."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return shard
+    r = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    parts = [shard]
+    buf = shard
+    for _ in range(n - 1):
+        buf = lax.ppermute(buf, axis_name, perm)
+        parts.append(buf)
+    # parts[k] at rank r is rank (r - k) mod n's shard; reorder to rank order
+    stack = jnp.stack(parts)
+    idx = (r - jnp.arange(n)) % n
+    return jnp.take(stack, idx, axis=0).reshape(-1)

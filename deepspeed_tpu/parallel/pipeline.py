@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.topology import AXIS_PIPE
+from deepspeed_tpu.utils.compat import shard_map_compat
 
 tree_map = jax.tree_util.tree_map
 
@@ -102,7 +103,7 @@ def pipeline_apply(layer_fn, stacked_params, x, mesh, num_microbatches: int = 0)
     param_specs = tree_map(lambda _: P(AXIS_PIPE), stacked_params)
     data_specs = tree_map(lambda a: P(*([None, b_entry] + [None] * (a.ndim - 2))), x_mb)
     out_specs = tree_map(lambda a: P(*([AXIS_PIPE, None, b_entry] + [None] * (a.ndim - 2))), x_mb)
-    out = jax.shard_map(
+    out = shard_map_compat(
         fn,
         mesh=mesh,
         in_specs=(param_specs, data_specs),

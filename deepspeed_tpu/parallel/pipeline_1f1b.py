@@ -36,6 +36,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.topology import AXIS_PIPE
+from deepspeed_tpu.utils.compat import shard_map_compat
 
 tree_map = jax.tree_util.tree_map
 
@@ -214,7 +215,7 @@ def pipeline_train_grads(
     rep = tree_map(lambda _: P(), extras)
     in_rep = tree_map(lambda _: P(), mb_in)
     tgt_rep = tree_map(lambda _: P(), mb_tgt)
-    return jax.shard_map(
+    return shard_map_compat(
         local, mesh=mesh,
         in_specs=(param_specs, rep, in_rep, tgt_rep),
         out_specs=(P(), param_specs, tree_map(lambda _: P(), extras)),

@@ -42,6 +42,7 @@ from deepspeed_tpu.parallel.sequence_tiling import (
     _from_tiles as _unchunk_seq,
     _to_tiles,
 )
+from deepspeed_tpu.utils.compat import axis_size_compat, shard_map_compat
 
 _NEG_INF = -1e30
 _MAX_Q_CHUNK = 2048
@@ -78,7 +79,7 @@ def _rotate(x, axis_name, n):
 
 def _ring_fwd_compute(q, k, v, axis_name: str, causal: bool, scale):
     """Online-softmax ring forward. Returns (o [b,s,h,d] in q.dtype, lse [b,h,s] fp32)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -153,7 +154,7 @@ def _ring_fwd_rule(q, k, v, axis_name, causal, scale):
 
 def _ring_bwd_rule(axis_name, causal, scale, res, do):
     q, k, v, o, lse = res
-    n = lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -240,6 +241,6 @@ def ring_attention(q, k, v, mesh, causal: bool = True, scale=None):
 
     def fn(q, k, v):  # custom_vjp nondiff args must be positional
         return _ring_attention_local(q, k, v, AXIS_SEQ, causal, scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map_compat(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={AXIS_SEQ},
                          check_vma=False)(q, k, v)

@@ -28,6 +28,7 @@ from deepspeed_tpu.comm.quantized_collectives import (
     quantized_all_reduce,
 )
 from deepspeed_tpu.comm.topology import batch_partition_axes
+from deepspeed_tpu.utils.compat import shard_map_compat
 
 
 def compressed_grad_allreduce(grads, error, mesh, bits: int = 8,
@@ -65,7 +66,7 @@ def compressed_grad_allreduce(grads, error, mesh, bits: int = 8,
         def body(gl, el):
             return quantized_all_reduce(gl, axis, el, bits=bits, block=block)
 
-        return jax.shard_map(
+        return shard_map_compat(
             body, mesh=mesh,
             in_specs=(spec, spec), out_specs=(spec, spec),
             axis_names={axis}, check_vma=False,

@@ -50,6 +50,7 @@ from deepspeed_tpu.runtime.lr_schedules import LRScheduler, build_schedule
 from deepspeed_tpu.runtime.precision import LossScaleState
 from deepspeed_tpu.utils.logging import log_dist
 from deepspeed_tpu.utils.timer import ThroughputTimer
+from deepspeed_tpu.utils.compat import shard_map_compat
 
 REMAT_POLICIES = {
     "full": None,
@@ -281,6 +282,71 @@ class Engine:
         self.optimizer = build_optimizer(config.optimizer, learning_rate=1.0)
         self._opt_shardings = opt_state_shardings(self.optimizer, self.params, self.plan)
 
+        # Overlap-first DP backward (parallel/grad_overlap.py, ROADMAP item 2):
+        # bucketed async ppermute-ring grad reduce-scatter inside a shard_map
+        # manual region + optional cross-replica sharded optimizer update
+        # (ZeRO-1 without the fsdp axis). `exact: true` is the kill switch —
+        # config surface stays but the step routes through the fused baseline
+        # program, bit-identical by construction.
+        go_cfg = zero.grad_overlap
+        self._overlap_enabled = bool(go_cfg.enabled)
+        self._grad_overlap = self._overlap_enabled and not go_cfg.exact
+        self._overlap_sharded = False
+        self._overlap_plan = None
+        self._overlap_opt_specs = None
+        if self._grad_overlap:
+            from deepspeed_tpu.parallel import grad_overlap as go_mod
+
+            dp = topo.size("data")
+            others = [a for a in ("fsdp", "tensor", "sequence", "pipeline",
+                                  "expert") if topo.size(a) > 1]
+            if dp <= 1 or others:
+                raise ValueError(
+                    "zero_optimization.grad_overlap reduces over a pure "
+                    f"data-parallel mesh (data>1, all other axes 1); got "
+                    f"data={dp}"
+                    + (f", unsupported axes {others}" if others else ""))
+            if zero.stage not in (0, 1):
+                raise ValueError(
+                    "grad_overlap replaces the GSPMD gradient sync on the "
+                    "pure-DP path; ZeRO stages 2/3 shard grads/params over "
+                    f"the fsdp axis instead (got stage {zero.stage})")
+            if zero.offload_optimizer.device != "none":
+                raise ValueError(
+                    "grad_overlap does not compose with offloaded optimizer "
+                    "state (the sharded update owns the optimizer tail)")
+            if zero.zenflow.enabled:
+                raise ValueError(
+                    "grad_overlap and zenflow are mutually exclusive "
+                    "(both restructure the optimizer tail)")
+            if zero.hierarchical_partitioning:
+                raise ValueError(
+                    "grad_overlap does not compose with "
+                    "hierarchical_partitioning (hpZ masters shard over the "
+                    "data axis the overlap rings run manual over)")
+            self._overlap_sharded = bool(go_cfg.sharded_update)
+            if self._overlap_sharded:
+                ot = config.optimizer.type.lower()
+                allowed = {"adam", "adamw", "sgd", "lion", "adagrad"}
+                if ot not in allowed:
+                    raise ValueError(
+                        f"grad_overlap.sharded_update requires an elementwise "
+                        f"optimizer ({', '.join(sorted(allowed))}); "
+                        f"{ot!r} mixes information across the param tree "
+                        "(set sharded_update: false to keep the bucketed "
+                        "rings with a replicated update)")
+            codec = (f"int{int(zero.quantized_gradients_bits)}"
+                     if zero.quantized_gradients else "fp32")
+            self._overlap_plan = go_mod.plan_buckets(
+                self.params, dp, go_cfg.bucket_bytes, codec=codec)
+            log_dist("grad_overlap: " + self._overlap_plan.describe()
+                     + (", sharded update (1/%d state touch)" % dp
+                        if self._overlap_sharded else ", replicated update"),
+                     ranks=[0])
+        elif self._overlap_enabled:
+            log_dist("grad_overlap: exact=true — routing through the fused "
+                     "baseline step program (kill switch)", ranks=[0])
+
         # ZeRO-Offload / ZeRO-Infinity tiers (reference: zero cpu-offload +
         # cpu_adam + runtime/swap_tensor). Offloaded optimizer state is
         # WINDOWED into sub-groups (reference stage3.py:2360 _prepare_sub_group)
@@ -365,6 +431,14 @@ class Engine:
                 f"optimizer state on NVMe ({zero.offload_optimizer.nvme_path}) "
                 f"in {len(self._groups)} sub-groups", ranks=[0],
             )
+        elif self._grad_overlap and self._overlap_sharded:
+            # ZeRO-1 flat layout: state over packed [dp, shard] bucket rows,
+            # row-sharded over the data axis — each rank holds exactly the
+            # 1/dp of the moments its grad shard updates. The bucket plan is
+            # deterministic (path-keyed), so this layout is stable across
+            # restarts and checkpoint round-trips.
+            (self.opt_state, self._overlap_opt_specs,
+             self._opt_shardings) = self._init_overlap_opt_state()
         else:
             self.opt_state = jax.jit(
                 self.optimizer.init, out_shardings=self._opt_shardings
@@ -566,6 +640,7 @@ class Engine:
                 "offloaded optimizer state":
                     zero.offload_optimizer.device != "none",
                 "zenflow": zero.zenflow.enabled,
+                "grad_overlap": self._grad_overlap,
             }
             bad = [k for k, v in conflicts.items() if v]
             if bad:
@@ -629,20 +704,33 @@ class Engine:
                     "quantized_gradients is not supported with NVMe-offloaded "
                     "optimizer state")
             n = topo.size("data")
-            # residuals: one per data rank, each carrying the grad's fsdp
-            # sharding on the param dims (no replicated full-size buffers)
-            err_shardings = jax.tree_util.tree_map(
-                lambda spec: NamedSharding(
-                    topo.mesh, PartitionSpec("data", *spec)),
-                self.plan.grad_specs,
-                is_leaf=lambda x: isinstance(x, PartitionSpec))
-            self._qgrad_error = jax.jit(
-                lambda: jax.tree_util.tree_map(
-                    lambda p: jnp.zeros((n,) + tuple(p.shape), jnp.float32),
-                    self.params,
-                ),
-                out_shardings=err_shardings,
-            )()
+            if self._grad_overlap:
+                # overlap path: one residual per BUCKET (the quantized
+                # reduction runs on the packed flat bucket, not per leaf),
+                # one row per data rank
+                err_sh = NamedSharding(topo.mesh, PartitionSpec("data"))
+                self._qgrad_error = tuple(
+                    jax.jit(
+                        lambda padded=b.padded: jnp.zeros((n, padded),
+                                                          jnp.float32),
+                        out_shardings=err_sh,
+                    )()
+                    for b in self._overlap_plan.buckets)
+            else:
+                # residuals: one per data rank, each carrying the grad's fsdp
+                # sharding on the param dims (no replicated full-size buffers)
+                err_shardings = jax.tree_util.tree_map(
+                    lambda spec: NamedSharding(
+                        topo.mesh, PartitionSpec("data", *spec)),
+                    self.plan.grad_specs,
+                    is_leaf=lambda x: isinstance(x, PartitionSpec))
+                self._qgrad_error = jax.jit(
+                    lambda: jax.tree_util.tree_map(
+                        lambda p: jnp.zeros((n,) + tuple(p.shape), jnp.float32),
+                        self.params,
+                    ),
+                    out_shardings=err_shardings,
+                )()
             log_dist(f"gradient reduction: {self._qgrad_bits}-bit quantized "
                      f"wire over the data axis (n={n}) with error feedback"
                      + (f", fsdp={topo.size('fsdp')} auto"
@@ -1076,7 +1164,10 @@ class Engine:
 
     def _build_train_batch_fn(self, use_qgrad: bool | None = None):
         self._record_comms_plan()
-        if self._qgrad if use_qgrad is None else use_qgrad:
+        uq = self._qgrad if use_qgrad is None else use_qgrad
+        if self._grad_overlap:
+            return self._build_train_batch_fn_overlap(use_qgrad=uq)
+        if uq:
             return self._build_train_batch_fn_qgrad()
         if (self.topo.size("pipeline") > 1
                 and self.config.pipeline.schedule == "1f1b"):
@@ -1110,45 +1201,119 @@ class Engine:
 
         return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2))
 
+    def _reduction_codec(self) -> tuple[str, float]:
+        """(codec, wire bytes/element) of the data-axis gradient reduction.
+
+        Derived from the CONFIG, not ``self._qgrad`` — the stepscope estimate
+        is built at ``__init__`` time, before the qgrad attrs exist. A 1-bit-
+        family warmup phase runs a dense wire; the estimate deliberately
+        reflects the steady-state (post-freeze_step) codec."""
+        from deepspeed_tpu.parallel.grad_overlap import wire_bytes_per_element
+
+        zero = self.config.zero_optimization
+        if zero.quantized_gradients:
+            codec = f"int{int(zero.quantized_gradients_bits)}"
+            return codec, wire_bytes_per_element(codec)
+        return "fp32", 4.0
+
     def _record_comms_plan(self) -> None:
         """Static comms plan of the fused step (comms_logging trace ledger).
 
         GSPMD inserts the gradient-sync collectives from shardings — no
         wrapper call ever fires at trace time — so the per-step plan is
-        recorded here once per program build: grad bytes are fp32 leaves."""
+        recorded here once per program build. Bytes follow the ACTIVE
+        reduction codec (qgZ quantizes the data-axis wire to intN + blockwise
+        fp32 scales; the old fp32 assumption overstated quantized runs ~4x);
+        under grad_overlap the plan is per BUCKET, and the bucket geometry is
+        exported as ``grad_bucket_*`` gauges (docs/OBSERVABILITY.md)."""
         from deepspeed_tpu.utils.comms_logging import COMMS_LOGGER
 
         dp, fs = self.topo.size("data"), self.topo.size("fsdp")
         if dp <= 1 and fs <= 1:
             return
-        grad_bytes = 4 * sum(
+        n_elems = sum(
             int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+        grad_bytes = 4 * n_elems
+        codec, bpe = self._reduction_codec()
         if fs > 1:
             # ZeRO over fsdp: reduce-scatter grads, all-gather updated params
+            # (always a dense fp32 wire — qgZ quantizes the data axis only)
             COMMS_LOGGER.append_traced("reduce_scatter", grad_bytes, "fsdp",
                                        fs, caller="train_batch_fn")
             COMMS_LOGGER.append_traced("all_gather", grad_bytes, "fsdp",
                                        fs, caller="train_batch_fn")
-        if dp > 1:
-            COMMS_LOGGER.append_traced("all_reduce", grad_bytes, "data",
-                                       dp, caller="train_batch_fn")
+        if dp <= 1:
+            return
+        if self._grad_overlap:
+            plan = self._overlap_plan
+            padded = sum(b.padded for b in plan.buckets)
+            for b in plan.buckets:
+                COMMS_LOGGER.append_traced(
+                    "reduce_scatter", b.wire_bytes, "data", dp,
+                    caller=f"grad_overlap/bucket{b.index}:{b.codec}")
+            if self._overlap_sharded:
+                # one ring all-gather of the UPDATED PARAMS (fp32), the
+                # ZeRO-1 tail
+                COMMS_LOGGER.append_traced(
+                    "all_gather", int(4.0 * padded * (dp - 1) / dp), "data",
+                    dp, caller="grad_overlap/params")
+            else:
+                for b in plan.buckets:
+                    COMMS_LOGGER.append_traced(
+                        "all_gather", b.wire_bytes, "data", dp,
+                        caller=f"grad_overlap/bucket{b.index}:{b.codec}")
+            if self.telemetry.enabled:
+                self.telemetry.gauge(
+                    "grad_bucket_count",
+                    "grad_overlap bucket count").set(float(len(plan.buckets)))
+                g_bytes = self.telemetry.gauge(
+                    "grad_bucket_bytes",
+                    "grad_overlap per-bucket payload bytes (fp32 accumulate)")
+                g_wire = self.telemetry.gauge(
+                    "grad_bucket_wire_bytes",
+                    "grad_overlap per-bucket ring reduce wire bytes under "
+                    "the active codec")
+                for b in plan.buckets:
+                    g_bytes.set(float(4 * b.elems),
+                                bucket=str(b.index), codec=b.codec)
+                    g_wire.set(float(b.wire_bytes),
+                               bucket=str(b.index), codec=b.codec)
+        else:
+            caller = ("train_batch_fn" if codec == "fp32"
+                      else f"train_batch_fn[{codec}]")
+            COMMS_LOGGER.append_traced("all_reduce", int(bpe * n_elems),
+                                       "data", dp, caller=caller)
 
     def _grad_wire_bytes(self) -> float:
         """Estimated per-step gradient-sync wire bytes (same plan as
         ``_record_comms_plan``, with ring-collective wire factors): feeds the
-        stepscope overlap estimate."""
+        stepscope overlap estimate. Codec-aware — see ``_reduction_codec``."""
         dp, fs = self.topo.size("data"), self.topo.size("fsdp")
         if dp <= 1 and fs <= 1:
             return 0.0
-        grad_bytes = 4 * sum(
+        n_elems = sum(
             int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+        grad_bytes = 4.0 * n_elems
+        _, bpe = self._reduction_codec()
         wire = 0.0
         if fs > 1:
             # ring reduce-scatter + all-gather each move (n-1)/n of the data
             wire += 2.0 * grad_bytes * (fs - 1) / fs
         if dp > 1:
-            # ring all-reduce = reduce-scatter + all-gather
-            wire += 2.0 * grad_bytes * (dp - 1) / dp
+            if self._grad_overlap:
+                plan = self._overlap_plan
+                rs = float(sum(b.wire_bytes for b in plan.buckets))
+                padded = sum(b.padded for b in plan.buckets)
+                if self._overlap_sharded:
+                    # grad reduce-scatter (codec wire) + fp32 all-gather of
+                    # the updated params
+                    wire += rs + 4.0 * padded * (dp - 1) / dp
+                else:
+                    # per-bucket ring reduce-scatter + ring all-gather
+                    wire += 2.0 * rs
+            else:
+                # ring all-reduce = reduce-scatter + all-gather
+                wire += 2.0 * bpe * n_elems * (dp - 1) / dp
         return wire
 
     def _jit_miss_count(self) -> float:
@@ -1204,7 +1369,7 @@ class Engine:
                         jax.tree_util.tree_unflatten(tdef, red),
                         jax.tree_util.tree_unflatten(tdef, nerr))
 
-            loss, acc, new_qerr = jax.shard_map(
+            loss, acc, new_qerr = shard_map_compat(
                 local, mesh=mesh,
                 in_specs=(PartitionSpec(), PartitionSpec(None, AXIS_DATA),
                           PartitionSpec(AXIS_DATA)),
@@ -1223,6 +1388,271 @@ class Engine:
             return new_params, new_opt, new_scale, metrics, new_qerr
 
         return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2, 6))
+
+    def _init_overlap_opt_state(self):
+        """ZeRO-1 flat optimizer state for the overlap sharded update: pack
+        the params into the plan's per-bucket ``[dp, shard]`` rows (the exact
+        view the sharded tail updates), init the optimizer over that tuple,
+        and row-shard every array leaf over the data axis — each rank holds
+        the 1/dp of the moments its grad shard updates. Returns
+        ``(state, partition-spec tree, sharding tree)``; the sharding tree
+        replaces ``self._opt_shardings`` so checkpoint restore places the
+        flat state without special-casing."""
+        from deepspeed_tpu.parallel import grad_overlap as go_mod
+
+        plan = self._overlap_plan
+        mesh = self.topo.mesh
+
+        def init(params):
+            leaves, _ = go_mod.ordered_leaves(params, plan)
+            rows = tuple(
+                go_mod.pack_bucket(leaves, b).reshape(plan.dp, b.shard)
+                for b in plan.buckets)
+            return self.optimizer.init(rows)
+
+        abstract = jax.eval_shape(init, self.params)
+        specs = jax.tree_util.tree_map(
+            lambda l: (PartitionSpec("data") if getattr(l, "ndim", 0) >= 1
+                       else PartitionSpec()),
+            abstract)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        state = jax.jit(init, out_shardings=shardings)(self.params)
+        return state, specs, shardings
+
+    def _build_train_batch_fn_overlap(self, use_qgrad: bool = False):
+        """Overlap-first fused step (docs/TP_OVERLAP.md "grad-sync overlap";
+        T3-style fine-grained overlap, arxiv 2401.16677). The GAS fwd/bwd
+        runs per data rank inside a shard_map manual over the DATA axis, then
+        each size-targeted bucket of the grad tree reduce-scatters through
+        its own async ppermute ring. Each ring depends only on its bucket's
+        grad leaves — not the full tree, unlike the fused GSPMD all-reduce —
+        so XLA's latency-hiding scheduler issues one bucket's transfer while
+        backward compute for other buckets is still in flight.
+
+        With ``sharded_update`` the optimizer tail is ZeRO-1 over the data
+        axis without fsdp machinery (arxiv 2004.13336): each rank updates
+        only its reduce-scattered grad shard against its ``[1, shard]`` slice
+        of the flat optimizer state, then ring-all-gathers the updated
+        params — optimizer FLOPs and state-touch bytes drop by 1/dp.
+
+        Numerics vs the fused baseline are documented-fp-reorder-bounded
+        (ring summation order; local-mean-then-pmean loss); the
+        ``grad_overlap.exact`` kill switch routes back through the baseline
+        program, which is bit-identical by construction. With ``use_qgrad``
+        the buckets ride the qgZ quantized collective (per-bucket error
+        feedback) on the same schedule."""
+        from deepspeed_tpu.comm.topology import AXIS_DATA
+        from deepspeed_tpu.parallel import grad_overlap as go_mod
+
+        if use_qgrad:
+            from deepspeed_tpu.comm.quantized_collectives import (
+                quantized_all_reduce)
+
+        mesh = self.topo.mesh
+        cfg = self.config
+        plan = self._overlap_plan
+        dp = plan.dp
+        n_micro = float(self.gas)
+        sharded = self._overlap_sharded
+        sentinel = self._sentinel is not None
+        P = PartitionSpec
+
+        def _scheduled_lr(step):
+            lr = self.lr_schedule(step)
+            if self._lr_scale != 1.0:
+                lr = lr * jnp.float32(self._lr_scale)
+            return lr
+
+        def reduce_buckets(acc, qerr):
+            """Per-bucket data-axis reduction inside the manual region.
+            ``acc`` is the GAS-SUM of local-batch-mean grads; the ring sum
+            / dp (or the quantized collective's mean) makes each bucket the
+            rank-mean analog the update denom expects. Returns this rank's
+            ``[shard]`` slices when sharded, full ``[padded]`` flats when
+            replicated, plus the advanced qgZ residuals."""
+            leaves, _ = go_mod.ordered_leaves(acc, plan)
+            outs, nerr = [], []
+            for b in plan.buckets:
+                flat = go_mod.pack_bucket(leaves, b)
+                if use_qgrad:
+                    red, ne = quantized_all_reduce(
+                        flat, AXIS_DATA, qerr[b.index][0],
+                        bits=self._qgrad_bits)
+                    nerr.append(ne[None])
+                    outs.append(go_mod.local_shard(red, AXIS_DATA, dp)
+                                if sharded else red)
+                else:
+                    rs = go_mod.ring_reduce_scatter_sum(flat, AXIS_DATA) / dp
+                    outs.append(rs if sharded
+                                else go_mod.ring_all_gather(rs, AXIS_DATA))
+            return outs, (tuple(nerr) if use_qgrad else None)
+
+        if not sharded:
+            # replicated update: per-bucket ring reduce (RS + AG = async
+            # all-reduce) feeds the unchanged ``_update`` tail
+            def make_step(with_sent):
+                def step_fn(params, opt_state, scale_state, step, base_rng,
+                            batch, *extra):
+                    def local(params, batch, *rest):
+                        qerr = rest[0] if use_qgrad else None
+                        self._inside_manual_region = True
+                        self.shard_ctx._manual_axes = {AXIS_DATA}
+                        try:
+                            loss, acc = self._gas_grads(
+                                params, scale_state, step, base_rng, batch)
+                        finally:
+                            self._inside_manual_region = False
+                            self.shard_ctx._manual_axes = ()
+                        fulls, nerr = reduce_buckets(acc, qerr)
+                        _, tdef = jax.tree_util.tree_flatten(acc)
+                        acc_mean = go_mod.unflatten_buckets(fulls, plan, tdef)
+                        out = (jax.lax.pmean(loss, AXIS_DATA), acc_mean)
+                        return out + ((nerr,) if use_qgrad else ())
+
+                    in_specs = (P(), P(None, AXIS_DATA))
+                    out_specs = (P(), P())
+                    operands = (params, batch)
+                    if use_qgrad:
+                        in_specs += (P(AXIS_DATA),)
+                        out_specs += (P(AXIS_DATA),)
+                        operands += (extra[0],)
+                    res = go_mod.shard_map_compat(
+                        local, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, axis_names={AXIS_DATA},
+                        check_vma=False,
+                    )(*operands)
+                    loss, acc = res[0], res[1]
+                    if with_sent:
+                        new_params, new_opt, new_scale, metrics, new_sent = \
+                            self._update(
+                                params, opt_state, scale_state, acc, n_micro,
+                                step, loss=loss, sent_state=extra[0])
+                        metrics["loss"] = loss
+                        return (new_params, new_opt, new_scale, metrics,
+                                new_sent)
+                    new_params, new_opt, new_scale, metrics = self._update(
+                        params, opt_state, scale_state, acc, n_micro, step)
+                    metrics["loss"] = loss
+                    if use_qgrad:
+                        finite = jnp.logical_not(metrics["skipped"])
+                        new_qerr = _tree_select(finite, res[2], extra[0])
+                        return (new_params, new_opt, new_scale, metrics,
+                                new_qerr)
+                    return new_params, new_opt, new_scale, metrics
+
+                return step_fn
+
+            if use_qgrad or sentinel:
+                return jax.jit(make_step(sentinel),
+                               donate_argnums=(0, 1, 2, 6))
+            return jax.jit(make_step(False), donate_argnums=(0, 1, 2))
+
+        # sharded update: the WHOLE optimizer tail lives inside the manual
+        # region, mirroring ``_update`` operation-for-operation on 1/dp views
+        def make_sharded_step():
+            def step_fn(params, opt_state, scale_state, step, base_rng,
+                        batch, *extra):
+                sent_state = extra[0] if sentinel else None
+                qerr = extra[0] if use_qgrad else None
+
+                def local(params, batch, opt_flat, *rest):
+                    q = rest[0] if use_qgrad else None
+                    self._inside_manual_region = True
+                    self.shard_ctx._manual_axes = {AXIS_DATA}
+                    try:
+                        loss, acc = self._gas_grads(
+                            params, scale_state, step, base_rng, batch)
+                    finally:
+                        self._inside_manual_region = False
+                        self.shard_ctx._manual_axes = ()
+                    shards, nerr = reduce_buckets(acc, q)
+                    loss = jax.lax.pmean(loss, AXIS_DATA)
+                    # ---- _update tail on 1/dp shards (same op order)
+                    denom = scale_state.scale * n_micro
+                    gsh = [s / denom for s in shards]
+                    bad = sum(
+                        jnp.sum(jnp.logical_not(jnp.isfinite(g))
+                                .astype(jnp.int32)) for g in gsh)
+                    finite = jax.lax.psum(bad, AXIS_DATA) == 0
+                    ssq = sum(jnp.sum(jnp.square(g)) for g in gsh)
+                    gnorm = jnp.sqrt(jax.lax.psum(ssq, AXIS_DATA))
+                    if cfg.gradient_clipping > 0:
+                        coef = jnp.minimum(
+                            1.0, cfg.gradient_clipping / (gnorm + 1e-6))
+                        gsh = [g * coef for g in gsh]
+                    lr = _scheduled_lr(step)
+                    gate = finite
+                    sent_out = ()
+                    if sentinel:
+                        new_sent, anomaly, reason, streak = \
+                            sentinel_mod.verdict(sent_state, loss, gnorm,
+                                                 finite, cfg.sentinel)
+                        gate = jnp.logical_not(anomaly)
+                        sent_out = (new_sent, anomaly, reason, streak)
+                    p_leaves, p_tdef = go_mod.ordered_leaves(params, plan)
+                    p_rows = tuple(
+                        go_mod.local_shard(
+                            go_mod.pack_bucket(p_leaves, b), AXIS_DATA, dp
+                        ).reshape(1, -1)
+                        for b in plan.buckets)
+                    g_rows = tuple(g.reshape(1, -1) for g in gsh)
+                    updates, new_opt = self.optimizer.update(
+                        g_rows, opt_flat, p_rows)
+                    updates = jax.tree_util.tree_map(lambda u: u * lr,
+                                                     updates)
+                    new_rows = optax.apply_updates(p_rows, updates)
+                    new_rows = _tree_select(gate, new_rows, p_rows)
+                    new_opt = _tree_select(gate, new_opt, opt_flat)
+                    full_flats = [
+                        go_mod.ring_all_gather(nr.reshape(-1), AXIS_DATA)
+                        for nr in new_rows]
+                    new_params = go_mod.unflatten_buckets(
+                        full_flats, plan, p_tdef)
+                    out = (loss, new_params, new_opt, gnorm, finite)
+                    out += sent_out
+                    return out + ((tuple(nerr),) if use_qgrad else ())
+
+                in_specs = (P(), P(None, AXIS_DATA), self._overlap_opt_specs)
+                out_specs = (P(), P(), self._overlap_opt_specs, P(), P())
+                operands = (params, batch, opt_state)
+                if sentinel:
+                    out_specs += (P(), P(), P(), P())
+                if use_qgrad:
+                    in_specs += (P(AXIS_DATA),)
+                    out_specs += (P(AXIS_DATA),)
+                    operands += (qerr,)
+                res = go_mod.shard_map_compat(
+                    local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    axis_names={AXIS_DATA}, check_vma=False,
+                )(*operands)
+                loss, new_params, new_opt, gnorm, finite = res[:5]
+                new_scale = precision.update_loss_scale(
+                    scale_state, finite, cfg.fp16)
+                metrics = {
+                    "grad_norm": gnorm,
+                    "lr": _scheduled_lr(step),
+                    "loss_scale": scale_state.scale,
+                    "skipped": jnp.logical_not(finite),
+                    "loss": loss,
+                }
+                if sentinel:
+                    new_sent, anomaly, reason, streak = res[5:9]
+                    metrics["anomalous"] = anomaly
+                    metrics["anomaly_reason"] = reason
+                    metrics["skip_streak"] = streak
+                    return new_params, new_opt, new_scale, metrics, new_sent
+                if use_qgrad:
+                    new_qerr = _tree_select(finite, res[5], qerr)
+                    return new_params, new_opt, new_scale, metrics, new_qerr
+                return new_params, new_opt, new_scale, metrics
+
+            return step_fn
+
+        if use_qgrad or sentinel:
+            return jax.jit(make_sharded_step(), donate_argnums=(0, 1, 2, 6))
+        return jax.jit(make_sharded_step(), donate_argnums=(0, 1, 2))
 
     def _build_grads_fn(self):
         """Jitted fwd/bwd over the GAS scan WITHOUT the optimizer tail — the
@@ -1944,13 +2374,14 @@ class Engine:
         sharded per the ZeRO plan until ``step()`` consumes them.
         """
         if (self._offload_mode == "nvme" or self._qgrad or self._zenflow
+                or self._grad_overlap
                 or self.config.progressive_layer_drop.enabled
                 or self._compression is not None):
             raise NotImplementedError(
                 "the fwd/bwd/step parity path does not support NVMe-offloaded "
                 "optimizer state, quantized gradient reduction, zenflow, "
-                "progressive layer drop, or compression training; use "
-                "train_batch()"
+                "grad_overlap, progressive layer drop, or compression "
+                "training; use train_batch()"
             )
         if self.config.debug.sanity_checks:
             micro_total = (self.config.train_batch_size or 0) // self.gas or None

@@ -16,9 +16,10 @@ from deepspeed_tpu.utils.comms_logging import COMMS_LOGGER
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map_compat
 
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    return shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=False)
 
 
 def test_topology_auto_data_axis(mesh8):
