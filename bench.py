@@ -3058,6 +3058,388 @@ def smoke_main():
     return 0 if ok else 1
 
 
+# ------------------------------------------------------------- autotuning
+# shared tiny model for autotune probe legs: the parent search computes the
+# profile fingerprint from the SAME spec the probe children measure, so the
+# persisted winner round-trips through initialize()/router lookup by key
+_PROBE_MODEL = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    max_seq_len=256)
+_PROBE_SEQ = 128
+
+
+def _probe_model_builder():
+    from deepspeed_tpu.models import llama
+
+    cfg = llama.LlamaConfig(**_PROBE_MODEL)
+    return cfg, (lambda ctx: llama.build(cfg, ctx=ctx))
+
+
+def _set_dotted(d: dict, dotted: str, value):
+    node = d
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def _probe_train(overrides, steps):
+    """One bounded train probe leg: tiny engine + stepscope, scored by
+    goodput x MFU (samples/s standing in for MFU on backends without a
+    peak-FLOPs model) x (1 + overlap fraction)."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.topology import reset_topology
+    from deepspeed_tpu.telemetry import TELEMETRY
+
+    model_cfg, builder = _probe_model_builder()
+    config = {
+        "train_micro_batch_size_per_device": 2,
+        "sequence_length": _PROBE_SEQ,
+        "steps_per_print": 0,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": -1},
+        "telemetry": {"enabled": True,
+                      "stepscope": {"enabled": True,
+                                    "profile_interval_steps": 0}},
+    }
+    for name, value in overrides.items():
+        _set_dotted(config, name, value)
+    reset_topology()
+    TELEMETRY.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=builder, config=config)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"input_ids": rng.integers(
+            0, model_cfg.vocab_size,
+            (engine.train_batch_size, _PROBE_SEQ), dtype=np.int32)}
+
+    float(engine.train_batch(batch()))  # compile + settle
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(max(steps, 1)):
+        loss = engine.train_batch(batch())
+    float(loss)  # settle before reading the clock
+    dt = (time.perf_counter() - t0) / max(steps, 1)
+    summary = engine.stepscope.summary()
+    goodput = float(summary.get("goodput") or 0.0)
+    mfu = float(summary.get("mfu") or 0.0)
+    overlap = float(summary.get("overlap_fraction") or 0.0)
+    samples_per_sec = engine.train_batch_size / dt
+    engine.destroy()
+    return {
+        "score": goodput * (mfu if mfu > 0.0 else samples_per_sec)
+        * (1.0 + overlap),
+        "goodput": round(goodput, 4),
+        "mfu": round(mfu, 6),
+        "overlap_fraction": round(overlap, 4),
+        "samples_per_sec": round(samples_per_sec, 2),
+        "step_ms": round(dt * 1000, 2),
+        "phase_seconds_total": summary.get("phase_seconds_total"),
+    }
+
+
+def _probe_serve(overrides, steps):
+    """One bounded serving probe leg: tiny ragged engine on a pure-decode
+    workload, scored by tokens/s x SLO-good fraction; the memory census
+    (<= 5% unattributed) and token parity vs the plain host-staged path
+    are HARD gates — a perf config that leaks or changes tokens is a
+    non-result whatever its throughput."""
+    import numpy as np
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.ragged import RaggedConfig, RaggedInferenceEngine
+    from deepspeed_tpu.telemetry import SloMonitor, default_objectives
+
+    model_cfg, builder = _probe_model_builder()
+    n_req, prompt_len = 4, 16
+    max_new = max(8, 4 * int(steps))
+    block = 16
+    mbs = -(-(prompt_len + max_new) // block)
+    base = dict(max_tokens_per_step=64, max_seqs=n_req, block_size=block,
+                num_blocks=n_req * mbs + 1, max_blocks_per_seq=mbs)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model_cfg.vocab_size, (prompt_len,),
+                            dtype=np.int32) for _ in range(n_req)]
+
+    def build(device_state=True, **over):
+        kw = dict(base)
+        kw.update(over)
+        return RaggedInferenceEngine(
+            model=builder, seed=0,
+            ragged_config=RaggedConfig(device_state=device_state, **kw))
+
+    def run(engine, tag):
+        for i, p in enumerate(prompts):
+            engine.put((tag, i), p, max_new_tokens=max_new)
+        return engine.generate_all()
+
+    tel = telemetry.configure(enabled=True, memledger={"enabled": True},
+                              hbm_watermarks=False)
+    try:
+        engine = build(**overrides)
+        run(engine, "warm")  # compiles every bucket this workload hits
+        t0 = time.perf_counter()
+        out = run(engine, "run")
+        dt = max(time.perf_counter() - t0, 1e-9)
+        toks = sum(len(v) for v in out.values())
+        tokens_per_s = toks / dt
+        # census while the candidate is the only live engine: its pool +
+        # params must be attributed, or the config is disqualified
+        led = tel.memledger
+        census = led.census(update_state=False) if led is not None else None
+        census_ok = (census is None
+                     or census["unattributed_fraction"] <= 0.05)
+        # SLO burn over the measured leg: per-token decode latency samples
+        mon = SloMonitor(default_objectives(), tel.registry)
+        per_tok = dt / max(toks, 1)
+        for i in range(n_req):
+            mon.record("decode_latency", per_tok, now=float(i))
+        slo = mon.stats("decode_latency", now=float(n_req))
+    finally:
+        telemetry.configure(enabled=False)
+
+    # token parity: the candidate's dispatch path vs the plain host-staged
+    # baseline under the SAME codec/cache knobs, greedy + seeded sampling
+    def parity_run(engine):
+        for i, p in enumerate(prompts[:3]):
+            kw = {} if i == 0 else dict(temperature=0.9, top_k=20,
+                                        top_p=0.9, seed=7 + i)
+            engine.put(i, p, max_new_tokens=6, **kw)
+        return engine.generate_all()
+
+    dispatch_knobs = ("sched_steps", "spec_draft", "decode_run_ahead",
+                      "prefill_tile", "fused_chunk", "pipeline_depth")
+    plain = {k: v for k, v in overrides.items() if k not in dispatch_knobs}
+    parity_ok = (parity_run(build(device_state=False, **plain))
+                 == parity_run(build(**overrides)))
+
+    return {
+        "score": tokens_per_s * slo["good_fraction"],
+        "tokens_per_s": round(tokens_per_s, 2),
+        "slo_good_fraction": round(slo["good_fraction"], 4),
+        "slo_burn_rate": round(slo["burn_rate"], 4),
+        "census_unattributed_fraction":
+            None if census is None else census["unattributed_fraction"],
+        "census_ok": census_ok,
+        "parity_ok": parity_ok,
+        "tokens": toks,
+        "wall_s": round(dt, 3),
+    }
+
+
+def probe_main():
+    """Child process: ONE bounded autotuner probe leg (``--mode probe``).
+
+    JSON-only output. An OOM/compile failure inside the leg prints a
+    structured ``{"error": ...}`` line and exits 0 — the PR 6 child-error
+    discipline: rc != 0 is reserved for a dead interpreter, and the hard
+    wall-clock timeout lives in the parent (run_probe_subprocess)."""
+    try:
+        spec = json.loads(os.environ.get("BENCH_PROBE_SPEC") or "{}")
+    except json.JSONDecodeError as e:
+        _fail_json({"reason": f"bad BENCH_PROBE_SPEC: {e}"})
+        return 0
+    kind = spec.get("kind", "train")
+    overrides = dict(spec.get("overrides") or {})
+    steps = int(spec.get("steps", 3))
+    try:
+        if kind == "train":
+            out = _probe_train(overrides, steps)
+        elif kind == "serve":
+            out = _probe_serve(overrides, steps)
+        else:
+            _fail_json({"reason": f"unknown probe kind {kind!r}"})
+            return 0
+    except Exception as e:  # OOM / compile failure = structured result
+        _fail_json({"reason": f"{type(e).__name__}: {e}"[:500],
+                    "kind": kind, "overrides": overrides})
+        return 0
+    out.update(error=None, kind=kind, overrides=overrides, steps=steps)
+    print(json.dumps(out))
+    return 0
+
+
+def run_probe_subprocess(spec: dict, timeout: float | None = None):
+    """Bounded probe leg with a hard wall-clock timeout; returns
+    ``(result, None)`` or ``(None, structured_error)``."""
+    t = float(spec.get("timeout_s") or timeout or 180.0)
+    result, err = _run_flagged_subprocess(
+        "BENCH_PROBE", t, extra_env={"BENCH_PROBE_SPEC": json.dumps(spec)})
+    if result is not None and result.get("error"):
+        return None, result["error"]
+    return result, err
+
+
+def autotune_bench_main():
+    """Child process: the end-to-end measurement-driven autotune loop on a
+    tiny model (``--mode autotune``, the CI smoke budget).
+
+    Search both engines over trimmed knob sets via bounded probe legs
+    (each leg a run_probe_subprocess child sharing the jit cache), with a
+    synthetic headroom budget sized so at least one candidate is pruned
+    before compiling; persist the winners as content-keyed profiles; then
+    prove the round trip — a fresh ``initialize`` picks the tuned train
+    knobs up (and an explicitly-written config key beats them), and the
+    serving router loads the serve profile at startup. One JSON line."""
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.autotuning import (
+        SERVE,
+        TRAIN,
+        KnobSearch,
+        probe_model_info,
+        profiles,
+    )
+    from deepspeed_tpu.comm.topology import reset_topology
+    from deepspeed_tpu.telemetry import TELEMETRY
+
+    t_all = time.perf_counter()
+    runs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "runs")
+    profile_dir = (os.environ.get("BENCH_AUTOTUNE_DIR")
+                   or os.path.join(runs_dir, "autotune"))
+    steps = int(os.environ.get("BENCH_AUTOTUNE_STEPS", 3))
+    _, builder = _probe_model_builder()
+    info = probe_model_info(builder)
+    fp = profiles.model_fingerprint(info)
+    topo = profiles.current_topology()
+    # counters (autotune_{trials,pruned,failed}_total) land in the registry
+    telemetry.configure(enabled=True, hbm_watermarks=False)
+
+    def runner(kind, overrides, probe_steps):
+        return run_probe_subprocess({
+            "kind": kind, "overrides": overrides, "steps": probe_steps,
+            "timeout_s": float(os.environ.get("BENCH_AUTOTUNE_PROBE_TIMEOUT",
+                                              120.0))})
+
+    # synthetic headroom budget: the CPU backend reports no bytes_limit, so
+    # an explicit budget stands in for the TPU's measured one — sized so
+    # micro_batch=8 fits and the 16 corner is pruned without compiling
+    est8 = info.state_bytes(0, 1) + info.activation_bytes(8, _PROBE_SEQ)
+    limit = est8 * 1.3 / 0.9
+
+    train = KnobSearch(
+        TRAIN, model_info=info, steps=steps, seq_len=_PROBE_SEQ,
+        memory_bytes=limit, n_devices=jax.device_count(),
+        knob_names=("train_micro_batch_size_per_device",
+                    "activation_checkpointing.enabled"),
+        probe_runner=runner, profile_dir=profile_dir).tune()
+    serve = KnobSearch(
+        SERVE, model_info=info, steps=steps,
+        knob_names=("sched_steps", "fused_chunk"),
+        probe_runner=runner, profile_dir=profile_dir).tune()
+
+    # --- round trip 1: a fresh initialize() loads the train profile ------
+    reset_topology()
+    TELEMETRY.reset()
+    telemetry.configure(enabled=True, hbm_watermarks=False)
+    raw = {
+        "sequence_length": _PROBE_SEQ,
+        "steps_per_print": 0,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "autotuning": {"enabled": True, "profile_dir": profile_dir},
+    }
+    tuned_mb = train["best_overrides"].get(
+        "train_micro_batch_size_per_device")
+    if tuned_mb is None:  # profile carries no batch knob: pin one ourselves
+        raw["train_micro_batch_size_per_device"] = 2
+    engine, _, _, _ = deepspeed_tpu.initialize(model=builder, config=raw)
+
+    def _cfg_get(cfg, dotted):
+        node = cfg
+        for part in dotted.split("."):
+            node = getattr(node, part)
+        return node
+
+    reloaded_by_engine = all(
+        _cfg_get(engine.config, k) == v
+        for k, v in train["best_overrides"].items())
+    engine_gauge_ok = ("tuned_profile_loaded"
+                      in TELEMETRY.registry.render_prometheus())
+    engine.destroy()
+
+    # --- round trip 2: an explicitly-written config key beats the profile
+    reset_topology()
+    raw2 = dict(raw, train_micro_batch_size_per_device=1)
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=builder, config=raw2)
+    config_wins_ok = engine2.config.train_micro_batch_size_per_device == 1
+    engine2.destroy()
+
+    # --- round trip 3: the serving router loads the serve profile --------
+    from deepspeed_tpu.inference.ragged import RaggedConfig, RaggedInferenceEngine
+    from deepspeed_tpu.serving.engine_loop import EngineLoop
+    from deepspeed_tpu.serving.router import ReplicaRouter, RouterConfig
+
+    prof = profiles.load_profile(profile_dir, subsystem=SERVE,
+                                 fingerprint=fp, workload="default")
+    rcfg = RaggedConfig(max_tokens_per_step=64, max_seqs=4, block_size=16,
+                        num_blocks=17, max_blocks_per_seq=4)
+    applied = (profiles.apply_serving_profile(rcfg, prof)
+               if prof else {"applied": {}, "skipped": {}})
+    serve_applied_ok = all(getattr(rcfg, k) == v
+                           for k, v in serve["best_overrides"].items())
+    sengine = RaggedInferenceEngine(model=builder, ragged_config=rcfg,
+                                    seed=0)
+    router = ReplicaRouter(
+        [EngineLoop(sengine, name="replica-0")],
+        RouterConfig(autotune_profile_dir=profile_dir,
+                     autotune_fingerprint=fp))
+    reloaded_by_router = (router.tuned_overrides()
+                          == serve["best_overrides"])
+    router.refresh_metrics()
+    router_gauge_ok = ('tuned_profile_loaded{kind="serving"}'
+                       in TELEMETRY.registry.render_prometheus())
+
+    def _leg(summary):
+        return {k: summary[k] for k in (
+            "best_overrides", "best_score", "baseline_score", "trials",
+            "pruned", "failed", "gate_failures", "gate_violations_accepted",
+            "profile_path")}
+
+    autotune_ok = bool(
+        train["pruned"] + serve["pruned"] >= 1
+        and train["best_score"] >= train["baseline_score"]
+        and serve["best_score"] >= serve["baseline_score"]
+        and train["gate_violations_accepted"] == 0
+        and serve["gate_violations_accepted"] == 0
+        and reloaded_by_engine and engine_gauge_ok and config_wins_ok
+        and serve_applied_ok and reloaded_by_router and router_gauge_ok)
+    print(json.dumps({
+        "error": None,
+        "autotune_ok": autotune_ok,
+        "backend": jax.default_backend(),
+        "fingerprint": fp,
+        "topology": topo,
+        "train": _leg(train),
+        "serve": _leg(serve),
+        "pruned_total": train["pruned"] + serve["pruned"],
+        "gate_violations_accepted": (train["gate_violations_accepted"]
+                                     + serve["gate_violations_accepted"]),
+        "profile": {
+            "dir": profile_dir,
+            "reloaded_by_engine": reloaded_by_engine,
+            "engine_gauge_ok": engine_gauge_ok,
+            "config_wins_ok": config_wins_ok,
+            "serve_applied": applied["applied"],
+            "serve_applied_ok": serve_applied_ok,
+            "reloaded_by_router": reloaded_by_router,
+            "router_gauge_ok": router_gauge_ok,
+        },
+        "total_s": round(time.perf_counter() - t_all, 1),
+    }))
+    return 0 if autotune_ok else 1
+
+
+def run_autotune_subprocess(timeout: float = 900.0):
+    return _run_flagged_subprocess("BENCH_AUTOTUNE", timeout)
+
+
 def main():
     if "--mode" in sys.argv:
         mode = sys.argv[sys.argv.index("--mode") + 1:][:1]
@@ -3115,10 +3497,37 @@ def main():
                 return 1
             print(json.dumps(result))
             return 0 if result.get("fleet_ok") else 1
+        if mode == ["probe"]:
+            # one bounded autotuner probe leg; spec JSON via --probe-spec
+            spec = {}
+            if "--probe-spec" in sys.argv:
+                val = sys.argv[sys.argv.index("--probe-spec") + 1:][:1]
+                try:
+                    spec = json.loads(val[0]) if val else {}
+                except json.JSONDecodeError as e:
+                    print(f"bench: bad --probe-spec: {e}", file=sys.stderr)
+                    return 2
+            result, err = run_probe_subprocess(spec)
+            if result is None:
+                print(f"probe failed:\n{_err_text(err)}", file=sys.stderr)
+                _fail_json(err)
+                return 1
+            print(json.dumps(result))
+            return 0
+        if mode == ["autotune"]:
+            # end-to-end measurement-driven autotune loop (docs/AUTOTUNING.md)
+            result, err = run_autotune_subprocess()
+            if result is None:
+                print(f"autotune bench failed:\n{_err_text(err)}",
+                      file=sys.stderr)
+                _fail_json(err)
+                return 1
+            print(json.dumps(result))
+            return 0 if result.get("autotune_ok") else 1
         if mode != ["serving"]:
             print(f"bench: unknown --mode {mode or '(missing)'}; "
                   "supported: serving, decode-steady, chaos, train-anatomy, "
-                  "train-chaos, pipeline, fleet",
+                  "train-chaos, pipeline, fleet, probe, autotune",
                   file=sys.stderr)
             return 2
         if "--disagg" in sys.argv:
@@ -3165,6 +3574,16 @@ def main():
     if "--smoke" in sys.argv or os.environ.get("BENCH_SMOKE"):
         _enable_jit_cache()
         return smoke_main()
+    if os.environ.get("BENCH_PROBE"):
+        # checked before BENCH_AUTOTUNE: the autotune orchestrator's flag
+        # leaks into its probe children's environments, and a probe leg
+        # must never recurse into orchestration. Probe legs share the jit
+        # cache so repeated tiny-model compiles amortize across the search.
+        _enable_jit_cache()
+        return probe_main()
+    if os.environ.get("BENCH_AUTOTUNE"):
+        _enable_jit_cache()
+        return autotune_bench_main()
     if os.environ.get("BENCH_TRAIN_CHAOS_WORKER"):
         # checked before BENCH_TRAIN_CHAOS: the orchestrator's own env flag
         # leaks into inherited worker environments unless popped there, and

@@ -1,0 +1,27 @@
+"""Measurement-driven autotuning (docs/AUTOTUNING.md).
+
+``Autotuner`` is the original in-process training sweep; ``KnobSearch`` is
+the general driver over the ``KnobSpace`` registry that tunes both engines
+via bounded ``bench.py`` probe legs and persists content-keyed profiles
+(``profiles``) that ``deepspeed_tpu.initialize`` and the serving router
+load at startup.
+"""
+
+from deepspeed_tpu.autotuning import profiles  # noqa: F401
+from deepspeed_tpu.autotuning.autotuner import (  # noqa: F401
+    Autotuner,
+    KnobSearch,
+    ModelInfo,
+    TrialResult,
+    default_probe_runner,
+    device_memory_bytes,
+    probe_model_info,
+)
+from deepspeed_tpu.autotuning.knobs import (  # noqa: F401
+    DEFAULT_SPACE,
+    KNOBSPACE_VERSION,
+    SERVE,
+    TRAIN,
+    Knob,
+    KnobSpace,
+)

@@ -1,21 +1,36 @@
-"""Autotuner: measured search over stage x micro-batch x remat x offload x
-TP/SP x qgZ configs, with model-info-based pruning.
+"""Autotuner: measured search over the full knob space, both engines.
 
 Role parity with the reference ``autotuning/autotuner.py:42`` (``tune:404``):
 the reference first PROFILES the model (param count -> per-stage memory
 estimates) to prune the search space, then generates ZeRO-stage x micro-batch
 experiments, runs each, and refines around the best
-(``run_tuning_micro_batch_sizes:741``). Same shape here: phase 1 prunes and
-sweeps stage x micro-batch; phase 2 refines the winner across the
-offload/TP/SP/qgZ dimensions. The reference schedules experiments across free
-cluster nodes via the launcher; on TPU a trial is a fresh in-process engine
-(jit-compiled, measured for a few steps), so the whole search runs where the
-job runs. OOMs and compile failures are caught and recorded as failed trials,
-exactly like the reference's experiment records.
+(``run_tuning_micro_batch_sizes:741``). Same shape here, in two drivers:
+
+- ``Autotuner`` — the original in-process training sweep (phase 1 prunes and
+  sweeps stage x micro-batch; phase 2 refines the winner across the
+  offload/TP/SP/qgZ dimensions; phase 3 a bounded joint sweep).
+- ``KnobSearch`` — the general driver over the ``knobs.KnobSpace`` registry
+  (docs/AUTOTUNING.md): coordinate-ascent over BOTH engines' knobs, each
+  candidate headroom-pruned *before paying a compile* via the
+  ``ModelInfo`` memory math + knob cost hints, measured by a short bounded
+  ``bench.py`` probe leg in a child process (train legs scored by
+  goodput x MFU; serving legs by tokens/s x SLO-good fraction, with the
+  census and token-parity gates as hard disqualifiers), refined around the
+  winner on the continuous knobs, and persisted as a content-keyed profile
+  (profiles.py) that ``deepspeed_tpu.initialize`` and the serving router
+  load at startup.
+
+The reference schedules experiments across free cluster nodes via the
+launcher; on TPU a trial is a fresh engine in a child process (jit-compiled,
+measured for a few steps), so the whole search runs where the job runs. OOMs
+and compile failures are caught and recorded as failed trials, exactly like
+the reference's experiment records.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -37,6 +52,10 @@ class TrialResult:
     samples_per_sec: float = 0.0
     step_ms: float = 0.0
     error: str | None = None
+    # KnobSearch probe legs: the scalar objective + the probe's full metric
+    # dict (goodput/MFU/overlap or tokens_per_s/SLO burn/gates)
+    score: float = 0.0
+    metrics: dict = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -55,13 +74,20 @@ class ModelInfo:
     hidden_size: int
     num_layers: int
 
-    def state_bytes(self, stage: int, shards: int) -> float:
+    def state_bytes(self, stage: int, shards: int,
+                    sharded_update: bool = False) -> float:
         p = float(self.num_params)
-        if stage <= 0 or shards <= 1:
+        if shards <= 1 or (stage <= 0 and not sharded_update):
             return p * _STATE_BYTES_PER_PARAM
         # stages shard progressively more of the 18 bytes/param:
         # 1: opt state (12), 2: + grads (16), 3: + the bf16 live params (18)
-        shardable = {1: 12.0, 2: 16.0, 3: 18.0}[min(stage, 3)]
+        shardable = ({1: 12.0, 2: 16.0, 3: 18.0}[min(stage, 3)]
+                     if stage >= 1 else 0.0)
+        # grad_overlap.sharded_update shards the fp32 master + Adam m/v
+        # (12 bytes/param, the ZeRO-1 share) even at stage 0 — without this
+        # the pruner rejects overlap configs that actually fit (PR 18)
+        if sharded_update:
+            shardable = max(shardable, 12.0)
         resident = _STATE_BYTES_PER_PARAM - shardable
         return p * (resident + shardable / shards)
 
@@ -293,3 +319,277 @@ class Autotuner:
         log_dist(f"autotune best: {best.overrides} ({best.samples_per_sec:.1f} samples/s)",
                  ranks=[0])
         return best.overrides
+
+
+# ------------------------------------------------------------- knob search
+def _bump(name: str, help_text: str) -> None:
+    from deepspeed_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel.enabled:
+        tel.counter(name, help_text).inc()
+
+
+def default_probe_runner(kind: str, overrides: dict, steps: int = 3,
+                         timeout: float = 180.0,
+                         workload: str = "default"):
+    """Shell out to ``bench.py --mode probe`` (the ``BENCH_PROBE`` child):
+    bounded wall clock, JSON-only result, OOM/compile failures returned as
+    structured errors instead of a dead child. Returns ``(dict|None, err)``."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    bench = os.path.join(root, "bench.py")
+    env = dict(os.environ)
+    env["BENCH_PROBE"] = "1"
+    env["BENCH_PROBE_SPEC"] = json.dumps(
+        {"kind": kind, "overrides": overrides, "steps": steps,
+         "workload": workload})
+    try:
+        proc = subprocess.run(
+            [sys.executable, bench], env=env, capture_output=True,
+            text=True, timeout=timeout, cwd=root)
+    except subprocess.TimeoutExpired:
+        return None, {"reason": f"probe timed out after {timeout:g}s"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                res = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if res.get("error"):
+                return None, res["error"]
+            return res, None
+    return None, {"reason": "no JSON in probe output", "rc": proc.returncode,
+                  "stderr": (proc.stderr or "")[-2000:]}
+
+
+@dataclass
+class KnobSearch:
+    """General measured search over the KnobSpace registry (one subsystem
+    per search; run one for each engine). See the module docstring for the
+    shape: coordinate ascent + headroom pruning + bounded probe legs +
+    neighborhood refinement + content-keyed persistence."""
+
+    subsystem: str  # knobs.TRAIN | knobs.SERVE
+    model_info: ModelInfo | None = None
+    space: object = None  # KnobSpace; DEFAULT_SPACE when None
+    knob_names: tuple | None = None  # trim the sweep for a probe budget
+    probe_runner: object = None  # (kind, overrides, steps) -> (dict, err)
+    steps: int = 3
+    seq_len: int = 128
+    # device-byte budget for pruning; None = ask the backend (the CPU test
+    # mesh reports none -> pruning off, every candidate is measured)
+    memory_bytes: float | None = None
+    n_devices: int | None = None
+    cost_ctx: dict = field(default_factory=dict)  # knob cost-hint inputs
+    workload: str = "default"
+    profile_dir: str | None = None  # persist the winner when set
+    max_trials: int = 32
+    results: list = field(default_factory=list)
+
+    # ----------------------------------------------------------- plumbing
+    def _space(self):
+        if self.space is None:
+            from deepspeed_tpu.autotuning.knobs import DEFAULT_SPACE
+
+            self.space = DEFAULT_SPACE
+        return self.space
+
+    def _n_dev(self) -> int:
+        if self.n_devices is None:
+            import jax
+
+            self.n_devices = len(jax.devices())
+        return self.n_devices
+
+    def _knob_default(self, name):
+        return self._space().get(name).default
+
+    def _limit(self) -> float | None:
+        return (self.memory_bytes if self.memory_bytes is not None
+                else device_memory_bytes())
+
+    # ------------------------------------------------------------ pruning
+    def _estimate_bytes(self, overrides: dict) -> float | None:
+        """Candidate device-byte estimate, paid BEFORE any compile.
+
+        Train: the ModelInfo state/activation formula on the candidate's
+        stage x micro-batch x remat x sharded-update corner (the knobs
+        interact — one formula, not summed hints). Serve: the sum of the
+        knob cost hints over ``cost_ctx`` (extra bytes vs default)."""
+        from deepspeed_tpu.autotuning import knobs as K
+
+        ov = overrides
+        if self.subsystem == K.TRAIN:
+            info = self.model_info
+            if info is None or not info.num_params:
+                return None
+            g = lambda n: ov.get(n, self._knob_default(n))  # noqa: E731
+            stage = g("zero_optimization.stage")
+            mb = g("train_micro_batch_size_per_device")
+            sharded = (g("zero_optimization.grad_overlap.enabled")
+                       and g("zero_optimization.grad_overlap.sharded_update"))
+            act = info.activation_bytes(mb, self.seq_len)
+            if g("activation_checkpointing.enabled"):
+                act /= 2
+            return (info.state_bytes(stage, self._n_dev(),
+                                     sharded_update=sharded) + act)
+        est = 0.0
+        for name, value in ov.items():
+            try:
+                est += self._space().get(name).cost_bytes(value, self.cost_ctx)
+            except KeyError:
+                continue
+        return est if est > 0.0 else None
+
+    def _prune_reason(self, overrides: dict) -> str | None:
+        limit = self._limit()
+        if not limit:
+            return None
+        est = self._estimate_bytes(overrides)
+        if est is not None and est > 0.9 * limit:
+            return (f"pruned: est {est/1e9:.2f} GB > "
+                    f"0.9 x {limit/1e9:.2f} GB")
+        return None
+
+    # ------------------------------------------------------------- trials
+    def _record(self, res: TrialResult) -> TrialResult:
+        self.results.append(res)
+        log_dist(
+            f"autotune[{self.subsystem}] {res.overrides}: "
+            + (f"score {res.score:.4g}" if res.ok
+               else f"{'SKIPPED' if res.skipped else 'FAILED'} {res.error}"),
+            ranks=[0],
+        )
+        return res
+
+    def _probe(self, overrides: dict) -> TrialResult:
+        runner = self.probe_runner or default_probe_runner
+        _bump("autotune_trials_total",
+              "autotune probe legs actually measured (pruned excluded)")
+        result, err = runner(self.subsystem, overrides, self.steps)
+        if result is None:
+            _bump("autotune_failed_total",
+                  "autotune probe legs that errored or tripped a gate")
+            reason = (err or {}).get("reason") if isinstance(err, dict) else err
+            return self._record(TrialResult(
+                overrides=overrides, error=str(reason or "probe failed")[:300]))
+        # hard disqualifiers: a perf config that changes tokens or leaks
+        # memory is a non-result regardless of its score
+        gates = [g for g in ("parity_ok", "census_ok")
+                 if result.get(g) is False]
+        if gates:
+            _bump("autotune_failed_total",
+                  "autotune probe legs that errored or tripped a gate")
+            return self._record(TrialResult(
+                overrides=overrides, metrics=result,
+                error="gate: " + ", ".join(gates)))
+        return self._record(TrialResult(
+            overrides=overrides,
+            score=float(result.get("score", 0.0)),
+            samples_per_sec=float(result.get("samples_per_sec", 0.0) or 0.0),
+            step_ms=float(result.get("step_ms", 0.0) or 0.0),
+            metrics=result))
+
+    def _try(self, overrides: dict, tried: set, best: TrialResult):
+        key = tuple(sorted(overrides.items()))
+        if key in tried:
+            return best
+        tried.add(key)
+        measured = sum(1 for r in self.results if not r.skipped)
+        if measured >= self.max_trials:
+            return best
+        reason = self._prune_reason(overrides)
+        if reason:
+            _bump("autotune_pruned_total",
+                  "autotune candidates rejected by the headroom cost model "
+                  "before compiling")
+            self._record(TrialResult(overrides=overrides, error=reason))
+            return best
+        res = self._probe(overrides)
+        # strict >: ties keep the earlier (simpler / closer-to-default) config
+        if res.ok and res.score > best.score:
+            return res
+        return best
+
+    # -------------------------------------------------------------- search
+    def tune(self) -> dict:
+        """Run the search; returns the summary dict (winner + bookkeeping).
+
+        Coordinate ascent in registry order: each knob's domain is swept on
+        top of the best-so-far override set, then the continuous knobs get a
+        halve/double neighborhood pass around the winner. The hand-written
+        default is trial 0, so ``best_score >= baseline_score`` holds by
+        construction — the tuned profile can only ever match or beat it on
+        the probe objective."""
+        from deepspeed_tpu.autotuning import knobs as K
+
+        space = self._space()
+        sweep = space.knobs(self.subsystem, self.knob_names)
+        if not sweep:
+            raise ValueError(f"no knobs registered for {self.subsystem!r}")
+        self.results = []
+        tried: set = {()}
+        baseline = self._probe({})
+        if not baseline.ok:
+            raise RuntimeError(
+                f"autotuning: the default-config probe failed: {baseline.error}")
+        best = baseline
+        for knob in sweep:
+            for value in knob.domain:
+                cand = dict(best.overrides)
+                if value == knob.default:
+                    cand.pop(knob.name, None)
+                else:
+                    cand[knob.name] = value
+                best = self._try(cand, tried, best)
+        # neighborhood refinement around the winner (continuous knobs only)
+        for knob in sweep:
+            if not knob.continuous or knob.name not in best.overrides:
+                continue
+            for nv in knob.neighbors(best.overrides[knob.name]):
+                best = self._try({**best.overrides, knob.name: nv},
+                                 tried, best)
+
+        pruned = sum(1 for r in self.results if r.skipped)
+        failed = sum(1 for r in self.results if not r.ok and not r.skipped)
+        gate_failures = sum(1 for r in self.results
+                            if (r.error or "").startswith("gate:"))
+        summary = {
+            "subsystem": self.subsystem,
+            "workload": self.workload,
+            "best_overrides": best.overrides,
+            "best_score": best.score,
+            "baseline_score": baseline.score,
+            "baseline_metrics": baseline.metrics,
+            "best_metrics": best.metrics,
+            "trials": len(self.results) - pruned,
+            "pruned": pruned,
+            "failed": failed,
+            "gate_failures": gate_failures,
+            # accepted (scored) trials passed every gate by construction;
+            # violators are disqualified above and never become the winner
+            "gate_violations_accepted": 0,
+            "profile_path": None,
+        }
+        if self.profile_dir and self.model_info is not None:
+            from deepspeed_tpu.autotuning import profiles
+
+            summary["profile_path"] = profiles.save_profile(
+                self.profile_dir,
+                subsystem=(K.TRAIN if self.subsystem == K.TRAIN else K.SERVE),
+                fingerprint=profiles.model_fingerprint(self.model_info),
+                workload=self.workload,
+                overrides=best.overrides,
+                score=best.score,
+                baseline_score=baseline.score,
+                space=space)
+        log_dist(
+            f"autotune[{self.subsystem}] best: {best.overrides} "
+            f"(score {best.score:.4g} vs default {baseline.score:.4g}; "
+            f"{summary['trials']} measured, {pruned} pruned, "
+            f"{failed} failed)", ranks=[0])
+        return summary

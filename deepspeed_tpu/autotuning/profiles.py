@@ -1,0 +1,267 @@
+"""Tuned-profile persistence + startup application (docs/AUTOTUNING.md).
+
+A finished search persists its winner as a **content-keyed** profile under
+``runs/autotune/``: the key is a hash of (model fingerprint, topology,
+workload class, knob-space signature), so a profile can only ever be
+replayed against the exact shape it was measured on — change the model,
+the device count, or the knob registry and the lookup simply misses.
+
+Application precedence is explicit and one-directional: **config-file
+values always win over tuned values.** A train profile only fills knobs
+the user's raw config dict did not write (for programmatic ``Config``
+objects, knobs still at their dataclass default); a serving profile only
+fills ``RaggedConfig`` fields still at their default. What was applied vs
+skipped is logged, and the ``tuned_profile_loaded`` gauge says whether a
+profile was in effect at startup.
+
+Writes go through the PR 9 commit protocol (temp + fsync + ``os.replace``)
+so a crash mid-persist leaves the old profile or the new one, never a torn
+file; the loader additionally tolerates torn/garbage files (treated as
+absent) because profile dirs travel between machines by rsync.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from deepspeed_tpu.autotuning.knobs import DEFAULT_SPACE, SERVE, TRAIN
+from deepspeed_tpu.checkpoint.serialization import save_json
+from deepspeed_tpu.utils.logging import log_dist
+
+PROFILE_VERSION = 1
+DEFAULT_PROFILE_DIR = os.path.join("runs", "autotune")
+
+# train knobs that participate in the batch-size triangle: tuned values for
+# these only apply when the raw config pinned NONE of the triangle (a tuned
+# micro-batch under a user-pinned train_batch_size would silently change GAS)
+_BATCH_TRIANGLE = ("train_batch_size", "train_micro_batch_size_per_device",
+                   "gradient_accumulation_steps",
+                   "train_micro_batch_size_per_gpu")  # legacy alias
+
+
+def model_fingerprint(info) -> str:
+    """Stable identity of the model the profile was tuned for (ModelInfo
+    or anything with num_params/hidden_size/num_layers)."""
+    return (f"p{int(getattr(info, 'num_params', 0))}"
+            f"-h{int(getattr(info, 'hidden_size', 0))}"
+            f"-l{int(getattr(info, 'num_layers', 0))}")
+
+
+def current_topology() -> str:
+    """backend:device_count:device_kind — the facts that change a tuned
+    answer (a v5e profile means nothing on a v4 pod or the CPU mesh)."""
+    import jax
+
+    devs = jax.devices()
+    kind = getattr(devs[0], "device_kind", "unknown") if devs else "none"
+    return f"{jax.default_backend()}:{len(devs)}:{kind}"
+
+
+def profile_key(fingerprint: str, topology: str, workload: str,
+                subsystem: str, space=DEFAULT_SPACE) -> str:
+    blob = "|".join([f"pv{PROFILE_VERSION}", space.signature(), subsystem,
+                     fingerprint, topology, workload])
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def profile_path(profile_dir: str, subsystem: str, key: str) -> str:
+    return os.path.join(profile_dir, f"{subsystem}-{key}.json")
+
+
+def save_profile(profile_dir: str, *, subsystem: str, fingerprint: str,
+                 topology: str | None = None, workload: str = "default",
+                 overrides: dict, score: float, baseline_score: float,
+                 space=DEFAULT_SPACE, extra: dict | None = None) -> str:
+    """Persist one winner atomically; returns the committed path."""
+    if subsystem not in (TRAIN, SERVE):
+        raise ValueError(f"unknown subsystem {subsystem!r}")
+    topology = topology if topology is not None else current_topology()
+    key = profile_key(fingerprint, topology, workload, subsystem, space)
+    path = profile_path(profile_dir, subsystem, key)
+    save_json(path, {
+        "version": PROFILE_VERSION,
+        "key": key,
+        "subsystem": subsystem,
+        "fingerprint": fingerprint,
+        "topology": topology,
+        "workload": workload,
+        "knobspace": space.signature(),
+        "overrides": overrides,
+        "score": score,
+        "baseline_score": baseline_score,
+        **(extra or {}),
+    })
+    log_dist(f"autotune: persisted {subsystem} profile {path} "
+             f"(score {score:.4g} vs default {baseline_score:.4g})",
+             ranks=[0])
+    return path
+
+
+def load_profile(profile_dir: str, *, subsystem: str, fingerprint: str,
+                 topology: str | None = None, workload: str = "default",
+                 space=DEFAULT_SPACE) -> dict | None:
+    """Load the profile for (fingerprint, topology, workload) or None.
+
+    Missing, torn (non-JSON), or stale files (recorded identity disagrees
+    with the requested one — possible when files are copied between
+    machines) all read as "no profile"; stale/torn are logged loudly."""
+    topology = topology if topology is not None else current_topology()
+    key = profile_key(fingerprint, topology, workload, subsystem, space)
+    path = profile_path(profile_dir, subsystem, key)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            prof = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        log_dist(f"autotune: ignoring unreadable profile {path}: {e}",
+                 ranks=[0])
+        return None
+    stale = []
+    if prof.get("version") != PROFILE_VERSION:
+        stale.append(f"version {prof.get('version')} != {PROFILE_VERSION}")
+    if prof.get("fingerprint") != fingerprint:
+        stale.append(f"model {prof.get('fingerprint')} != {fingerprint}")
+    if prof.get("topology") != topology:
+        stale.append(f"topology {prof.get('topology')} != {topology}")
+    if prof.get("knobspace") != space.signature():
+        stale.append("knob space changed")
+    if not isinstance(prof.get("overrides"), dict):
+        stale.append("no overrides dict")
+    if stale:
+        log_dist(f"autotune: rejecting stale profile {path}: "
+                 + "; ".join(stale), ranks=[0])
+        return None
+    return prof
+
+
+# --------------------------------------------------------------- precedence
+def _raw_has(raw: dict, dotted: str) -> bool:
+    node = raw
+    for part in dotted.split("."):
+        if not isinstance(node, dict):
+            return False
+        # the deprecated "zero" spelling aliases zero_optimization
+        if part == "zero_optimization" and part not in node and "zero" in node:
+            part = "zero"
+        if part not in node:
+            return False
+        node = node[part]
+    return True
+
+
+def _cfg_at_default(cfg, dotted: str) -> bool:
+    from deepspeed_tpu.config.config import Config
+
+    fresh = Config()
+    node, ref = cfg, fresh
+    for part in dotted.split("."):
+        node = getattr(node, part)
+        ref = getattr(ref, part)
+    return node == ref
+
+
+def apply_train_profile(cfg, raw: dict | None, profile: dict) -> dict:
+    """Fill un-written train knobs from ``profile`` onto a loaded Config.
+
+    ``raw`` is the user's original config dict when one exists (explicit
+    keys there ALWAYS win); for programmatic Config objects (raw=None) a
+    knob counts as user-written when it differs from the dataclass default.
+    Returns ``{"applied": {...}, "skipped": {...}}`` for the log line."""
+    applied, skipped = {}, {}
+    for dotted, value in (profile.get("overrides") or {}).items():
+        if dotted == "train_micro_batch_size_per_device":
+            pinned = (any(_raw_has(raw, k) for k in _BATCH_TRIANGLE)
+                      if raw is not None
+                      else any(not _cfg_at_default(cfg, k)
+                               for k in _BATCH_TRIANGLE[:3]))
+            if pinned:
+                skipped[dotted] = value
+                continue
+            cfg.train_micro_batch_size_per_device = value
+            applied[dotted] = value
+            continue
+        explicit = (_raw_has(raw, dotted) if raw is not None
+                    else not _cfg_at_default(cfg, dotted))
+        if explicit:
+            skipped[dotted] = value
+            continue
+        try:
+            node = cfg
+            parts = dotted.split(".")
+            for part in parts[:-1]:
+                node = getattr(node, part)
+            setattr(node, parts[-1], value)
+            applied[dotted] = value
+        except AttributeError:
+            skipped[dotted] = value
+    return {"applied": applied, "skipped": skipped}
+
+
+def apply_serving_profile(ragged_config, profile: dict) -> dict:
+    """Fill still-at-default RaggedConfig fields from a serve profile
+    (a field the caller already set keeps its value: config wins)."""
+    from dataclasses import MISSING, fields as dc_fields
+
+    defaults = {}
+    for f in dc_fields(type(ragged_config)):
+        if f.default is not MISSING:
+            defaults[f.name] = f.default
+        elif f.default_factory is not MISSING:
+            defaults[f.name] = f.default_factory()
+    applied, skipped = {}, {}
+    for name, value in (profile.get("overrides") or {}).items():
+        if not hasattr(ragged_config, name):
+            skipped[name] = value
+            continue
+        if getattr(ragged_config, name) != defaults.get(name):
+            skipped[name] = value  # caller wrote it: config wins
+            continue
+        setattr(ragged_config, name, value)
+        applied[name] = value
+    return {"applied": applied, "skipped": skipped}
+
+
+def _set_loaded_gauge(kind: str, loaded: bool) -> None:
+    from deepspeed_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel.enabled:
+        tel.gauge(
+            "tuned_profile_loaded",
+            "1 when a persisted autotune profile was applied at startup",
+        ).set(1.0 if loaded else 0.0, kind=kind)
+
+
+def maybe_apply_train_profile(cfg, raw: dict | None, model) -> dict | None:
+    """The ``deepspeed_tpu.initialize`` hook: when ``cfg.autotuning.enabled``,
+    look up the profile for (this model, this topology, the configured
+    workload) and apply it under config-file-wins precedence. Returns the
+    applied/skipped record (None when no profile matched). Never raises —
+    a broken profile store must not stop a training job from starting."""
+    try:
+        from deepspeed_tpu.autotuning.autotuner import probe_model_info
+
+        info = probe_model_info(model)
+        fp = model_fingerprint(info)
+        prof = load_profile(cfg.autotuning.profile_dir, subsystem=TRAIN,
+                            fingerprint=fp, workload=cfg.autotuning.workload)
+        if prof is None:
+            _set_loaded_gauge(TRAIN, False)
+            log_dist(f"autotune: no train profile for {fp} "
+                     f"({current_topology()}, workload="
+                     f"{cfg.autotuning.workload!r})", ranks=[0])
+            return None
+        rec = apply_train_profile(cfg, raw, prof)
+        # loaded = a valid profile is in effect, even when every tuned knob
+        # was either config-pinned or already the default
+        _set_loaded_gauge(TRAIN, True)
+        log_dist(f"autotune: loaded train profile {prof['key']} — applied "
+                 f"{rec['applied']}, config-file kept {rec['skipped']}",
+                 ranks=[0])
+        return rec
+    except Exception as e:  # pragma: no cover - defensive
+        log_dist(f"autotune: profile load failed (ignored): {e}", ranks=[0])
+        return None

@@ -3380,7 +3380,22 @@ def initialize(
     """
     if model is None:
         raise ValueError("initialize() requires a model (ModelSpec or builder callable)")
+    if isinstance(config, str):
+        # read the file once here: the tuned-profile precedence check below
+        # needs the raw key set, not just the parsed Config
+        import json as _json
+
+        with open(config) as f:
+            config = _json.load(f)
     cfg = load_config(config)
+    if cfg.autotuning.enabled:
+        # fill knobs the config did not write from the persisted autotune
+        # profile for (this model, this topology, this workload); explicit
+        # config values always win (docs/AUTOTUNING.md)
+        from deepspeed_tpu.autotuning.profiles import maybe_apply_train_profile
+
+        maybe_apply_train_profile(
+            cfg, config if isinstance(config, dict) else None, model)
     mics = cfg.zero_optimization.mics_shard_size
     if mics > 0:
         # MiCS (reference mics.py:63): shard degree = group size k < world.
