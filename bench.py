@@ -1473,6 +1473,242 @@ def run_serving_subprocess(timeout: float = 900.0):
     return _run_flagged_subprocess("BENCH_SERVING", timeout)
 
 
+def tenant_bench_main():
+    """Child process: multi-tenant metering + fair-share trial
+    (``--mode serving --tenants N``, docs/OBSERVABILITY.md).
+
+    N tenants share one replica under open-loop load. Tenant 0 ("hog") is
+    a batch-class capacity hog — long prompts, long decodes, the highest
+    arrival rate; the rest are interactive-class bystanders. The verdict
+    checks the cost-attribution plane end to end: per-tenant block-seconds
+    must sum to the pool occupancy integral (+-5%), per-class SLO series
+    must exist, the ``/debug/tenants`` ledger must rank the hog first, and
+    the interactive tenants must actually complete (the fair-share signal
+    protecting them from the hog's backlog). One JSON line out.
+    """
+    import http.client
+    import threading
+
+    import numpy as np
+    import jax
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.ragged import (
+        RaggedConfig, RaggedInferenceEngine)
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.serving import RouterConfig, build_server
+
+    e = os.environ
+    n_tenants = max(2, int(e.get("BENCH_TENANTS_N", 2)))
+    model_cfg = llama.LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=688,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256)
+    max_seqs, budget, block, max_prompt, max_new = 4, 64, 16, 64, 8
+    hog_reqs = int(e.get("BENCH_TENANTS_HOG_REQUESTS", 8))
+    int_reqs = int(e.get("BENCH_TENANTS_INTERACTIVE_REQUESTS", 5))
+    rate = float(e.get("BENCH_SERVING_RATE", 6.0))
+
+    tel_path = e.get("BENCH_TELEMETRY_JSONL", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "runs",
+        "BENCH_tenants_telemetry.jsonl"))
+    telemetry.configure(enabled=True, jsonl_path=tel_path,
+                        costmeter={"enabled": True},
+                        slo={"enabled": True, "classes": True})
+
+    mbs = -(-(max_prompt + max_new) // block)
+    rcfg = RaggedConfig(
+        max_tokens_per_step=budget, max_seqs=max_seqs, block_size=block,
+        num_blocks=max_seqs * mbs + 1, max_blocks_per_seq=mbs,
+        enable_prefix_cache=True)
+    engine = RaggedInferenceEngine(
+        model=lambda ctx: llama.build(model_cfg, ctx=ctx),
+        ragged_config=rcfg, seed=0)
+    engine.warmup()
+    frontend, router, loops = build_server(
+        [engine], router_cfg=RouterConfig(
+            max_queue_tokens=int(e.get("BENCH_SERVING_QUEUE_TOKENS", 768))))
+
+    # workload: tenant 0 hogs (batch class, long prompts+decodes, front-
+    # loaded arrivals); tenants 1..N-1 are interactive bystanders. Distinct
+    # random prompts per request keep the block-seconds integral exact
+    # (shared blocks would be N x counted per tenant vs once in the pool).
+    rng = np.random.default_rng(0)
+    work = []  # (tenant, sla_class, prompt, max_tokens)
+    for _ in range(hog_reqs):
+        p = rng.integers(0, model_cfg.vocab_size, (max_prompt,),
+                         dtype=np.int32).tolist()
+        work.append(("hog", "batch", p, max_new))
+    for t in range(1, n_tenants):
+        for _ in range(int_reqs):
+            p = rng.integers(0, model_cfg.vocab_size, (16,),
+                             dtype=np.int32).tolist()
+            work.append((f"tenant{t}", "interactive", p, 4))
+    order = rng.permutation(len(work))
+    gaps = rng.exponential(1.0 / rate, len(work))
+    arrivals = np.cumsum(gaps)
+
+    results = []
+    results_lock = threading.Lock()
+
+    def one_request(tenant, sla_class, prompt, mx):
+        conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                          timeout=120)
+        body = json.dumps({"prompt": prompt, "max_tokens": mx,
+                           "stream": False, "tenant": tenant,
+                           "sla_class": sla_class})
+        t_send = time.perf_counter()
+        rec = {"tenant": tenant, "sla_class": sla_class, "rejected": False,
+               "latency": None, "tokens": 0, "echo_ok": False}
+        try:
+            conn.request("POST", "/v1/completions", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 429:
+                rec["rejected"] = True
+                return rec
+            if resp.status == 200:
+                rec["latency"] = time.perf_counter() - t_send
+                payload = json.loads(data)
+                rec["tokens"] = int(
+                    (payload.get("usage") or {}).get("completion_tokens", 0))
+                rec["echo_ok"] = (payload.get("tenant") == tenant
+                                  and payload.get("sla_class") == sla_class)
+        finally:
+            conn.close()
+        return rec
+
+    def http_get(path):
+        conn = http.client.HTTPConnection(frontend.host, frontend.port,
+                                          timeout=30)
+        try:
+            conn.request("GET", path)
+            return conn.getresponse().read().decode("utf-8")
+        finally:
+            conn.close()
+
+    threads = []
+    t0 = time.perf_counter()
+    for i, j in enumerate(order):
+        delay = t0 + arrivals[i] - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+
+        def fire(w=work[j]):
+            rec = one_request(*w)
+            with results_lock:
+                results.append(rec)
+
+        th = threading.Thread(target=fire, daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=300)
+    wall = time.perf_counter() - t0
+
+    metrics_text = http_get("/metrics")
+    debug_tenants = json.loads(http_get("/debug/tenants"))
+    frontend.drain(timeout=60)
+
+    # --- per-tenant / per-class rollups from the client's view
+    by_tenant: dict[str, dict] = {}
+    by_class: dict[str, list] = {"interactive": [], "batch": []}
+    for r in results:
+        d = by_tenant.setdefault(r["tenant"], {
+            "sla_class": r["sla_class"], "requests": 0, "completed": 0,
+            "rejected": 0, "tokens": 0, "latencies": []})
+        d["requests"] += 1
+        if r["rejected"]:
+            d["rejected"] += 1
+        elif r["latency"] is not None:
+            d["completed"] += 1
+            d["tokens"] += r["tokens"]
+            d["latencies"].append(r["latency"])
+            by_class[r["sla_class"]].append(r["latency"])
+
+    # --- ledger view: block-seconds share + the occupancy-integral check
+    rows = debug_tenants.get("tenants") or {}
+    pool_s = float(debug_tenants.get("pool_block_seconds") or 0.0)
+    tenant_s = {t: float(r.get("kv_block_seconds", 0.0))
+                + float(r.get("retained_block_seconds", 0.0))
+                for t, r in rows.items()}
+    total_s = sum(tenant_s.values())
+    integral_rel_err = (abs(total_s - pool_s) / pool_s if pool_s > 0
+                        else None)
+    integral_ok = integral_rel_err is not None and integral_rel_err <= 0.05
+
+    tenant_labels = set()
+    slo_classes = set()
+    for line in metrics_text.splitlines():
+        if line.startswith("request_cost_") and 'tenant="' in line:
+            tenant_labels.add(line.split('tenant="', 1)[1].split('"', 1)[0])
+        if line.startswith("slo_good_fraction") and 'sla_class="' in line:
+            slo_classes.add(
+                line.split('sla_class="', 1)[1].split('"', 1)[0])
+
+    top = debug_tenants.get("top_by_block_seconds") or []
+    interactive_done = sum(
+        d["completed"] for d in by_tenant.values()
+        if d["sla_class"] == "interactive")
+    interactive_total = sum(
+        d["requests"] for d in by_tenant.values()
+        if d["sla_class"] == "interactive")
+    # the fair-share verdict: every interactive request completed (the hog
+    # never starved the bystanders), every tenant shows up in the ledger,
+    # the hog tops the block-seconds ranking, and the echo held
+    fair_share_ok = bool(
+        interactive_total > 0
+        and interactive_done == interactive_total
+        and all(t in tenant_s for t in by_tenant)
+        and top and top[0]["tenant"] == "hog"
+        and all(r["echo_ok"] for r in results
+                if not r["rejected"] and r["latency"] is not None))
+
+    def p99_ms(vals):
+        return (round(float(np.percentile(vals, 99)) * 1e3, 2)
+                if vals else None)
+
+    telemetry.TELEMETRY.close()
+    print(json.dumps({
+        "metric": "serving_tenant_metering",
+        "tenants_requested": n_tenants,
+        "serving_wall_s": round(wall, 2),
+        "tenants": {
+            t: {
+                "sla_class": d["sla_class"],
+                "requests": d["requests"],
+                "completed": d["completed"],
+                "rejected": d["rejected"],
+                "tokens_per_s": round(d["tokens"] / wall, 2) if wall else 0.0,
+                "latency_p99_ms": p99_ms(d["latencies"]),
+                "block_seconds": round(tenant_s.get(t, 0.0), 6),
+                "block_seconds_share": round(tenant_s.get(t, 0.0) / total_s,
+                                             4) if total_s else 0.0,
+            } for t, d in by_tenant.items()},
+        "per_class": {
+            cls: {"completed": len(v), "p99_latency_ms": p99_ms(v)}
+            for cls, v in by_class.items()},
+        "pool_block_seconds": round(pool_s, 6),
+        "tenant_block_seconds_sum": round(total_s, 6),
+        "integral_rel_err": (round(integral_rel_err, 4)
+                             if integral_rel_err is not None else None),
+        "block_seconds_integral_ok": integral_ok,
+        "metrics_tenant_labels": sorted(tenant_labels),
+        "slo_class_series": sorted(slo_classes),
+        "debug_tenants_top": top,
+        "fair_share_ok": fair_share_ok,
+        "backend": jax.default_backend(),
+        "telemetry_jsonl": tel_path,
+    }))
+    return 0
+
+
+def run_tenants_subprocess(n_tenants: int = 2, timeout: float = 900.0):
+    return _run_flagged_subprocess(
+        "BENCH_TENANTS", timeout,
+        extra_env={"BENCH_TENANTS_N": str(n_tenants)})
+
+
 def disagg_bench_main():
     """Child process: disaggregated prefill/decode serving measurement
     (``--mode serving --disagg``, docs/SERVING.md).
@@ -3530,6 +3766,24 @@ def main():
                   "train-chaos, pipeline, fleet, probe, autotune",
                   file=sys.stderr)
             return 2
+        if "--tenants" in sys.argv:
+            # multi-tenant metering trial: N tenants (one batch-class hog +
+            # interactive bystanders) against one replica with the cost
+            # meter on — per-tenant tokens/s and block-seconds share, the
+            # occupancy-integral check, per-class SLO series and the
+            # fair-share verdict in the JSON line (docs/OBSERVABILITY.md)
+            val = sys.argv[sys.argv.index("--tenants") + 1:][:1]
+            if not val or not val[0].isdigit():
+                print("bench: --tenants needs an integer", file=sys.stderr)
+                return 2
+            result, err = run_tenants_subprocess(int(val[0]))
+            if result is None:
+                print(f"tenant bench failed:\n{_err_text(err)}",
+                      file=sys.stderr)
+                _fail_json(err)
+                return 1
+            print(json.dumps(result))
+            return 0 if result.get("fair_share_ok") else 1
         if "--disagg" in sys.argv:
             # disaggregated prefill/decode cluster trial (docs/SERVING.md):
             # parity verdict, KV-transfer volume, handoff latency, cluster
@@ -3614,6 +3868,11 @@ def main():
     if os.environ.get("BENCH_SERVING_DISAGG"):
         _enable_jit_cache()
         return disagg_bench_main()
+    if os.environ.get("BENCH_TENANTS"):
+        # checked before BENCH_SERVING: the tenant leg is its own child and
+        # must never fall through into the plain serving trial
+        _enable_jit_cache()
+        return tenant_bench_main()
     if os.environ.get("BENCH_SERVING"):
         _enable_jit_cache()
         return serving_bench_main()

@@ -101,6 +101,14 @@ class BlockedAllocator:
         return len(self._free) + len(self._lru)
 
     @property
+    def busy_blocks(self) -> int:
+        """Blocks holding live or retained KV right now: everything except
+        the scratch block and the truly-free list. The cost meter's pool
+        occupancy integral sums this over time (retained cached blocks ARE
+        occupancy — they are the prefix cache's rent)."""
+        return self.num_blocks - 1 - len(self._free)
+
+    @property
     def cached_blocks(self) -> int:
         """Blocks currently published in the prefix index (any refcount)."""
         return len(self._keys)
@@ -430,6 +438,13 @@ class _SeqState:
     # (advisory probe); admission re-validates the actual splice against it
     # and counts the shortfall instead of over-crediting (stale-probe fix)
     expected_cached: int = 0
+    # cost attribution (telemetry/costmeter.py): billing identity plus the
+    # per-request RequestCost record. ``cost`` is only ever non-None while
+    # a cost meter is configured, so ``seq.cost is not None`` is the
+    # complete hot-path guard at every charging seam.
+    tenant: str = "default"
+    sla_class: str = "interactive"
+    cost: Any = None
 
     def token_at(self, p: int) -> int:
         if p < len(self.prompt):
@@ -496,6 +511,11 @@ class KVHandoff:
     # (import_handoff raises; the cluster falls back to a cold submit)
     # instead of scattering bytes it would dequantize wrong.
     codec: str = "off"
+    # billing identity carried across the prefill->decode seam so the decode
+    # replica's cost meter attributes the adopted request to the same tenant
+    # (defaulted: records pickled by older peers import as tenant "default")
+    tenant: str = "default"
+    sla_class: str = "interactive"
 
     @property
     def n_blocks(self) -> int:
@@ -826,6 +846,16 @@ class RaggedInferenceEngine:
         # lifetime (only its enabled flag toggles), so dispatch paths guard
         # on one attribute read and allocate nothing while tracing is off
         self._tracer = self.telemetry.tracer
+        # ---- cost attribution (telemetry/costmeter.py) ----
+        # the meter is read live off the bus at each seam (reconfiguration
+        # mid-flight picks it up); per-seq charges guard on seq.cost, and
+        # with no meter configured none of this state is ever touched.
+        # _block_tenant maps published block id -> publishing tenant so the
+        # retained-prefix carveout and cross-tenant splice credit/debit know
+        # who to bill (bounded by num_blocks; overwritten on republish).
+        self._block_tenant: dict[int, str] = {}
+        self._cost_last_tick = 0.0
+        self._flops_per_token: float | None = None
         # compile observability: every dispatch notes whether its jitted
         # program already existed (warm) or was created now (cold = a jit
         # cache miss at serve time); warmup() flips _warmed so coverage
@@ -875,7 +905,9 @@ class RaggedInferenceEngine:
             deadline_s: float | None = None,
             seed: int | None = None, trace=None,
             handoff: bool = False,
-            expected_cached_tokens: int = 0) -> None:
+            expected_cached_tokens: int = 0,
+            tenant: str = "default",
+            sla_class: str = "interactive") -> None:
         """Enqueue a request (reference ``engine_v2.py put()``). Admission into
         the running batch happens inside ``step()`` as slots/budget free up.
         ``temperature``/``top_k``/``top_p`` select per-request sampling
@@ -938,7 +970,7 @@ class RaggedInferenceEngine:
                          else self._tracer.extract(None))
         else:
             trace_ctx = None
-        self._queued.append(_SeqState(
+        seq = _SeqState(
             uid=uid, prompt=prompt, max_new_tokens=eff_new,
             eos_token_id=eos_token_id if eos_token_id is not None else self.eos_token_id,
             temperature=float(temperature), top_k=int(top_k),
@@ -948,7 +980,12 @@ class RaggedInferenceEngine:
             trace=trace_ctx,
             handoff=bool(handoff), handoff_budget=int(max_new_tokens),
             expected_cached=max(0, int(expected_cached_tokens)),
-        ))
+            tenant=str(tenant), sla_class=str(sla_class),
+        )
+        cm = self.telemetry.costmeter
+        if cm is not None:
+            seq.cost = cm.start(seq.tenant, seq.sla_class)
+        self._queued.append(seq)
         if self.telemetry.enabled:
             self.telemetry.counter(
                 "inference_requests_queued_total", "requests accepted").inc()
@@ -1072,9 +1109,14 @@ class RaggedInferenceEngine:
         bs = self.cfg.block_size
         n_full = min(seq.pos, len(seq.prompt)) // bs
         key = None
+        track = seq.cost is not None
         for i in range(n_full):
             key = (key, tuple(seq.prompt[i * bs:(i + 1) * bs]))
             self.allocator.publish(seq.blocks[i], key)
+            if track:
+                # record the publisher so retained-prefix occupancy and
+                # cross-tenant splices can be billed to the right party
+                self._block_tenant[seq.blocks[i]] = seq.tenant
 
     # ------------------------------------- KV transfer (disaggregated serving)
     def set_prefix_listener(self, listener) -> None:
@@ -1392,11 +1434,17 @@ class RaggedInferenceEngine:
             row_iv=iv, row_fv=fv,
             traceparent=(format_traceparent(seq.trace)
                          if seq.trace is not None else None),
-            codec=self._kvq_name)
+            codec=self._kvq_name,
+            tenant=seq.tenant, sla_class=seq.sla_class)
+        if seq.cost is not None:
+            # settle the parked occupancy and bill the exported payload
+            self._cost_tick()
+            seq.cost.handoff_export_bytes += rec.nbytes
         if self.cfg.enable_prefix_cache:
             self._publish_prompt_blocks(seq)
         self.allocator.free(seq.blocks)
         seq.blocks = []
+        self._finalize_cost(seq)
         if self.telemetry.enabled:
             self.telemetry.counter(
                 "kv_transfer_blocks_total",
@@ -1410,10 +1458,13 @@ class RaggedInferenceEngine:
         seq = self._handoffs.pop(uid, None)
         if seq is None:
             return False
+        if seq.cost is not None:
+            self._cost_tick()
         if self.cfg.enable_prefix_cache:
             self._publish_prompt_blocks(seq)
         self.allocator.free(seq.blocks)
         seq.blocks = []
+        self._finalize_cost(seq)
         return True
 
     def import_handoff(self, h: KVHandoff) -> bool:
@@ -1463,7 +1514,13 @@ class RaggedInferenceEngine:
             deadline=(time.perf_counter() + h.deadline_remaining_s)
             if h.deadline_remaining_s else 0.0,
             t_enqueue=time.perf_counter() if self.telemetry.enabled else 0.0,
+            tenant=str(getattr(h, "tenant", "default")),
+            sla_class=str(getattr(h, "sla_class", "interactive")),
         )
+        cm = self.telemetry.costmeter
+        if cm is not None:
+            seq.cost = cm.start(seq.tenant, seq.sla_class)
+            seq.cost.handoff_import_bytes += h.nbytes
         if self._tracer.enabled and h.traceparent:
             # adopt the prefill replica's trace: this request's decode-side
             # spans parent under the exporting span, so the fleet-merged
@@ -1487,6 +1544,7 @@ class RaggedInferenceEngine:
                 self._publish_prompt_blocks(seq)
             self.allocator.free(blocks)
             seq.blocks = []
+            self._finalize_cost(seq)
             self._results[seq.uid] = seq
             return True
         slot = self._free_slots.pop()
@@ -1623,7 +1681,16 @@ class RaggedInferenceEngine:
             payload = self._gather_blocks([block])
         except Exception:  # noqa: BLE001 - a failed gather is a plain evict
             return False
-        return store.demote(key, payload)
+        ok = store.demote(key, payload)
+        if ok:
+            cm = self.telemetry.costmeter
+            if cm is not None:
+                # the demoted payload is the publishing tenant's working set
+                # moving tier-ward; the publisher carries the byte charge
+                tenant = self._block_tenant.get(block)
+                if tenant is not None:
+                    cm.demote_bytes(tenant, self._block_bytes())
+        return ok
 
     def _chain_keys(self, prompt: list[int]) -> list:
         """The prompt's full-block hash-chain keys, root-first, capped one
@@ -1707,7 +1774,11 @@ class RaggedInferenceEngine:
         if not keys:
             return
         store.note_admission(keys[-1])
-        self._tier_promote(seq.prompt)
+        promoted = self._tier_promote(seq.prompt)
+        if promoted and seq.cost is not None:
+            # the admitting request is who needed the restore: it carries
+            # the promote-byte charge (restored bytes re-entering HBM)
+            seq.cost.tier_promote_bytes += promoted * self._block_bytes()
 
     def tier_prefetch_async(self, prompt_tokens) -> bool:
         """Advisory cross-thread prefetch kick (the serving router calls
@@ -1771,6 +1842,10 @@ class RaggedInferenceEngine:
     def _release(self, seq: _SeqState) -> None:
         self._reserved -= seq.reserved_remaining  # return unused reservation
         seq.reserved_remaining = 0
+        if seq.cost is not None:
+            # close the occupancy integral over this sequence's final slice
+            # before its blocks return to the pool
+            self._cost_tick()
         if seq.handoff and seq.status == "finished":
             # prefill-stage retirement: PARK the KV blocks (refcounts held)
             # for export_handoff() instead of freeing them — only the slot
@@ -1817,13 +1892,18 @@ class RaggedInferenceEngine:
                           if n_gen > 1 and seq.t_first_token else None)
         dur = (seq.t_last_token - seq.t_enqueue
                if seq.t_last_token and seq.t_enqueue else 0.0)
+        cost_attrs = {}
+        if seq.cost is not None:
+            if queue_wait is not None:
+                seq.cost.queue_wait_s = max(0.0, queue_wait)
+            cost_attrs = seq.cost.span_attrs()
         tel.emit_span(
             "inference/request", dur, uid=str(seq.uid),
             status=seq.status,
             queue_wait_s=queue_wait, ttft_s=ttft,
             decode_latency_s=decode_latency,
             prompt_tokens=len(seq.prompt), new_tokens=n_gen,
-            preemptions=seq.preemptions)
+            preemptions=seq.preemptions, **cost_attrs)
         if seq.status == "cancelled":
             tel.counter("inference_requests_cancelled_total",
                         "requests aborted via cancel()").inc()
@@ -1840,12 +1920,13 @@ class RaggedInferenceEngine:
         if ttft is not None:
             tel.histogram("inference_ttft_seconds",
                           "time to first token").observe(ttft)
-            tel.observe_slo("ttft", ttft)
+            tel.observe_slo("ttft", ttft, sla_class=seq.sla_class)
         if decode_latency is not None:
             tel.histogram("inference_decode_latency_seconds",
                           "mean inter-token decode latency").observe(
                               decode_latency)
-            tel.observe_slo("decode_latency", decode_latency)
+            tel.observe_slo("decode_latency", decode_latency,
+                            sla_class=seq.sla_class)
         if seq.trace is not None:
             # close the request's umbrella span: every queue/admission/
             # dispatch/readback child recorded along the way nests under it
@@ -1861,6 +1942,87 @@ class RaggedInferenceEngine:
             # a finished prefill-stage seq keeps its context parked with the
             # KV blocks: export_handoff stamps it as the record's traceparent
             # so the decode replica's spans stitch under this trace
+        if not (seq.handoff and seq.status == "finished"):
+            # a parked handoff keeps accruing block-seconds until export/
+            # discard retires its blocks; everyone else settles up now
+            self._finalize_cost(seq)
+
+    def _finalize_cost(self, seq: _SeqState) -> None:
+        """Fold the request's RequestCost into the meter exactly once."""
+        cost = seq.cost
+        if cost is None:
+            return
+        seq.cost = None
+        cm = self.telemetry.costmeter
+        if cm is not None:
+            if not cost.queue_wait_s and seq.t_admit and seq.t_enqueue:
+                cost.queue_wait_s = max(0.0, seq.t_admit - seq.t_enqueue)
+            cm.observe(cost)
+
+    def _cost_tick(self) -> None:
+        """Advance the KV occupancy integral: charge every block-holding
+        sequence (running + parked handoffs) and the retained prefix
+        carveout (credited to publishing tenants) for the slice since the
+        last tick. Called at the seams where block ownership changes —
+        admission, release, handoff export/discard — plus the periodic
+        step-telemetry sampler so long decodes accrue continuously."""
+        cm = self.telemetry.costmeter
+        if cm is None:
+            return
+        now = time.perf_counter()
+        last = self._cost_last_tick
+        self._cost_last_tick = now
+        if not last:
+            return  # first tick only establishes the baseline
+        dt = now - last
+        if dt <= 0.0:
+            return
+        live = [(s.cost, len(s.blocks)) for s in self._running.values()
+                if s.cost is not None and s.blocks]
+        for s in self._handoffs.values():
+            if s.cost is not None and s.blocks:
+                live.append((s.cost, len(s.blocks)))
+        alloc = self.allocator
+        retained: list[tuple[str, int]] = []
+        if alloc._lru:
+            bt = self._block_tenant
+            counts: dict[str, int] = {}
+            for b in alloc._lru:
+                t = bt.get(b)
+                if t is not None:
+                    counts[t] = counts.get(t, 0) + 1
+            retained = list(counts.items())
+        cm.tick(dt, live, retained, alloc.busy_blocks)
+
+    def _cost_fair_index(self, cm) -> int:
+        """Index of the queued request admission should try next under the
+        fair-share policy: the first whose tenant is at/under its fair share
+        of outstanding blocks. Single-tenant queues (and queues where every
+        tenant is over — everyone equally hungry) return 0, i.e. plain FIFO."""
+        q = self._queued
+        first = q[0].tenant
+        if all(s.tenant == first for s in q):
+            return 0
+        for i, s in enumerate(q):
+            share, fair = cm.outstanding_share(s.tenant)
+            if share <= fair + 1e-9:
+                return i
+        return 0
+
+    def _flops_per_token_value(self) -> float:
+        """Analytic forward FLOPs per token (lazy; one profile per engine)."""
+        if self._flops_per_token is None:
+            try:
+                from deepspeed_tpu.profiling.flops_profiler import (
+                    get_model_profile,
+                )
+                prof = get_model_profile(self.spec, 1, 128,
+                                         with_compiled=False)
+                self._flops_per_token = float(prof.flops_fwd) / 128.0
+            except Exception:
+                self._flops_per_token = 0.0  # profile unavailable: tokens
+                # still counted, FLOPs column reads 0 rather than failing
+        return self._flops_per_token
 
     def _build_step(self) -> Callable:
         fwd = self.spec.ragged_forward_fn
@@ -2646,6 +2808,19 @@ class RaggedInferenceEngine:
                 a = int(np.asarray(rec["acc"]))
                 self.spec_proposed += p
                 self.spec_accepted += a
+                if p:
+                    # the device returns one aggregate (proposed, accepted)
+                    # per sched dispatch; apportion to tenants proportionally
+                    # to each sequence's committed steps this dispatch
+                    total_n = float(sum(int(steps[j])
+                                        for j in range(len(rec["emits"]))))
+                    if total_n > 0.0:
+                        for j, (seq, _ks) in enumerate(rec["emits"]):
+                            if seq.cost is None:
+                                continue
+                            frac = int(steps[j]) / total_n
+                            seq.cost.spec_proposed += p * frac
+                            seq.cost.spec_accepted += a * frac
                 if self.telemetry.enabled and p:
                     self.telemetry.counter(
                         "spec_tokens_proposed_total",
@@ -3581,6 +3756,11 @@ class RaggedInferenceEngine:
 
     def _append_tokens(self, seq: _SeqState, toks, out: dict) -> None:
         now = time.perf_counter() if self.telemetry.enabled else 0.0
+        if seq.cost is not None and not seq.finished:
+            # single choke point every dispatch mode funnels emitted tokens
+            # through: one dispatch participation, len(toks) decode tokens
+            seq.cost.decode_dispatches += 1
+            seq.cost.decode_tokens += len(toks)
         for t in toks:
             if seq.finished:
                 break  # post-EOS speculation: discard
@@ -3723,8 +3903,20 @@ class RaggedInferenceEngine:
             headroom = self.admission_headroom_blocks()
             if headroom >= 0:
                 self._enforce_retained_budget()
+        cm = self.telemetry.costmeter
+        if cm is not None and self._queued:
+            # advance the occupancy integral before any splice moves blocks
+            # between the retained carveout and a live sequence
+            self._cost_tick()
         while self._queued and self._free_slots:
-            seq = self._queued[0]
+            qidx = 0
+            if cm is not None and len(self._queued) > 1:
+                # fair-share admission: prefer the first queued request
+                # whose tenant is at/under its fair share of live blocks.
+                # With one tenant (or one queued request) the pick is index
+                # 0 — byte-identical FIFO admission order.
+                qidx = self._cost_fair_index(cm)
+            seq = self._queued[qidx]
             t_adm0 = time.perf_counter() if seq.trace is not None else 0.0
             worst = self._worst_case_blocks(seq)
             if headroom >= 0 and worst > headroom:
@@ -3754,7 +3946,7 @@ class RaggedInferenceEngine:
                     # MRU end — they were just asked for)
                     self.allocator.free(hit)
                 break  # pool pressure: retry admission as blocks free up
-            self._queued.pop(0)
+            self._queued.pop(qidx)
             if seq.expected_cached and len(hit) * self.cfg.block_size \
                     < seq.expected_cached:
                 # the placement-time cached_prefix_tokens probe promised more
@@ -3781,6 +3973,27 @@ class RaggedInferenceEngine:
                 seq.pos = seq.cached_prefix
                 self.block_tables[seq.slot, :len(hit)] = hit
                 self._bt_dirty.add(seq.slot)
+            if seq.cost is not None:
+                # prefill is charged at admission: tokens the device will
+                # actually prefill (splice-skipped prefix excluded) times the
+                # analytic per-token forward FLOPs
+                n_pref = max(0, len(seq.prompt) - seq.pos)
+                seq.cost.prefill_tokens += n_pref
+                seq.cost.prefill_flops += n_pref * self._flops_per_token_value()
+                if hit:
+                    # cross-tenant prefix reuse: debit the consumer, credit
+                    # each publishing tenant block-for-block
+                    transfers: dict[str, int] = {}
+                    for b in hit:
+                        pub = self._block_tenant.get(b)
+                        if pub is not None and pub != seq.tenant:
+                            transfers[pub] = transfers.get(pub, 0) + 1
+                    # the transfer lands straight in the ledger (the
+                    # publisher's request is usually long gone); the
+                    # consumer's RequestCost must NOT also carry the debit
+                    # or finalize would double-fold it
+                    for pub, nblk in transfers.items():
+                        cm.prefix_transfer(pub, seq.tenant, nblk)
             self._running[seq.slot] = seq
             if self.cfg.device_state:
                 self._write_slot_row(seq)
@@ -3873,6 +4086,9 @@ class RaggedInferenceEngine:
                 seq.generated.append(int(tok))
                 out[seq.uid] = int(tok)
                 self.tokens_emitted += 1
+                if seq.cost is not None:
+                    seq.cost.decode_tokens += 1
+                    seq.cost.decode_dispatches += 1
                 if now:
                     self._stamp_emission(seq, now)
                 if seq.finished:
@@ -4091,6 +4307,8 @@ class RaggedInferenceEngine:
         with ``status='error'`` (the loop surfaces structured errors for
         them); returns how many were failed."""
         failed = 0
+        if self.telemetry.costmeter is not None:
+            self._cost_tick()  # settle the occupancy integral's last slice
         for seq in (*self._queued, *self._running.values()):
             seq.status = "error"
             seq.blocks = []
@@ -4101,12 +4319,14 @@ class RaggedInferenceEngine:
             failed += 1
             if self.telemetry.enabled:
                 self._emit_request_span(seq)
+            self._finalize_cost(seq)
         for seq in self._handoffs.values():
             seq.status = "error"
             seq.blocks = []
             seq.slot = -1
             self._results[seq.uid] = seq
             failed += 1
+            self._finalize_cost(seq)
         self._handoffs.clear()
         self._queued = []
         self._running = {}
@@ -4143,6 +4363,8 @@ class RaggedInferenceEngine:
                           if self.cfg.spec_draft else None)
         self._hist_stale[:] = True
         self._sched_wait = False
+        self._block_tenant.clear()  # fresh allocator: stale block ids
+        self._cost_last_tick = 0.0
         self.cache = self._build_cache()
         self._consec_failures = 0
         self._refresh_memory_handles()
@@ -4174,6 +4396,10 @@ class RaggedInferenceEngine:
             # ledger configured after engine construction: register now
             # (mirrors the training engine's lazy first-step registration)
             self._register_memory_owners()
+        if tel.costmeter is not None:
+            # long decodes accrue block-seconds continuously, not only at
+            # admission/release seams
+            self._cost_tick()
         usable = self.cfg.num_blocks - 1  # block 0 is scratch
         free = self.allocator.free_blocks
         g = tel.gauge

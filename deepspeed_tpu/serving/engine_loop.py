@@ -472,7 +472,10 @@ class EngineLoop:
                             seed=req.seed, trace=req.trace_ctx,
                             handoff=getattr(req, "handoff", False),
                             expected_cached_tokens=getattr(
-                                req, "cached_tokens_hint", 0))
+                                req, "cached_tokens_hint", 0),
+                            tenant=getattr(req, "tenant", "default"),
+                            sla_class=getattr(
+                                req, "sla_class", "interactive"))
                     self._open[rid] = _Open(stream)
                 except ValueError as e:
                     stream._fail(str(e))
@@ -523,6 +526,25 @@ class EngineLoop:
         self._engine_stats = (
             len(eng._queued), len(eng._running), outstanding,
             eng.allocator.free_blocks - eng._reserved)
+        tel = get_telemetry()
+        if tel.enabled:
+            # per-priority inbox depth (docs/OBSERVABILITY.md): the default
+            # priority-0 row always publishes (so an empty inbox scrapes as
+            # an explicit 0, not an absent series), other priorities appear
+            # on first use and are zeroed — not left frozen — when they
+            # empty out
+            with self._lock:
+                depths: dict[int, int] = {}
+                for prio, _, _, _ in self._inbox:
+                    depths[prio] = depths.get(prio, 0) + 1
+            last = getattr(self, "_last_inbox_depths", None)
+            g = tel.gauge("serving_inbox_depth",
+                          "requests waiting in the loop inbox, "
+                          "by priority")
+            for prio in (set(depths) | set(last or ()) | {0}):
+                g.set(depths.get(prio, 0),
+                      replica=self.name, priority=str(prio))
+            self._last_inbox_depths = depths
 
     def _contain(self, exc: Exception) -> None:
         """Crash containment for one failed ``engine.step()``: fail only the

@@ -36,6 +36,10 @@ Endpoints:
   SLO burn, census drift, circuit-breaker/KV-tier stats, heartbeat ages,
   and the ``fleet_health`` verdict. A non-ok verdict also degrades
   ``/healthz`` (fleet-wide burn visible from any one worker's probe).
+- ``GET /debug/tenants`` — the cost meter's per-tenant ledger: cumulative
+  request costs, top-K tenants by KV block-seconds, rolling rates and the
+  label-cardinality accounting (``telemetry/costmeter.py``). ``{"enabled":
+  false}`` until ``telemetry.configure(costmeter={"enabled": True})``.
 
 Tracing: ``POST /v1/completions`` honors an incoming W3C ``traceparent``
 header (or head-samples a fresh trace when the tracer is enabled); the
@@ -228,6 +232,11 @@ def _make_handler(frontend: ServingFrontend):
                 payload = ({"enabled": False} if agg is None
                            else agg.debug_payload())
                 self._send_json(200, payload)
+            elif path == "/debug/tenants":
+                cm = get_telemetry().costmeter
+                payload = ({"enabled": False} if cm is None
+                           else cm.debug_payload())
+                self._send_json(200, payload)
             elif path == "/metrics":
                 router.refresh_metrics()
                 tel = get_telemetry()
@@ -392,7 +401,8 @@ def _make_handler(frontend: ServingFrontend):
                 request_id=req.request_id, tokens=tokens,
                 finish_reason=reason, prompt_tokens=len(req.prompt),
                 trace_id=(req.trace_ctx.trace_id
-                          if req.trace_ctx is not None else None))
+                          if req.trace_ctx is not None else None),
+                tenant=req.tenant, sla_class=req.sla_class)
             self._send_json(200, resp.to_json())
 
         def _stream_response(self, req, stream) -> None:
@@ -447,7 +457,8 @@ def _make_handler(frontend: ServingFrontend):
                                 request_id=req.request_id, tokens=tokens,
                                 finish_reason=value,
                                 prompt_tokens=len(req.prompt),
-                                trace_id=trace_id)
+                                trace_id=trace_id,
+                                tenant=req.tenant, sla_class=req.sla_class)
                             self.wfile.write(encode_sse(resp.to_json()))
                             self.wfile.write(sse_done())
                     if not resubmitted:

@@ -30,6 +30,21 @@ class ProtocolError(ValueError):
     """Invalid request payload (maps to HTTP 400)."""
 
 
+# documented admission-priority range (lower = sooner); anything outside is
+# a validation error, not a silent clamp — an out-of-range priority is
+# almost always a units bug on the client side
+PRIORITY_MIN = -32
+PRIORITY_MAX = 32
+
+# SLA classes a request may declare (docs/SERVING.md): "interactive" gets
+# the tight ttft/decode objectives, "batch" the relaxed ones
+SLA_CLASSES = ("interactive", "batch")
+
+# bound on the tenant identifier so the label can't smuggle unbounded
+# cardinality or junk into the metrics pipeline
+TENANT_MAX_LEN = 64
+
+
 def _require(cond: bool, msg: str) -> None:
     if not cond:
         raise ProtocolError(msg)
@@ -56,6 +71,11 @@ class CompletionRequest:
     # pins the request's sampling stream: the same (prompt, seed, sampling
     # params) replays the same tokens on any replica, cold or prefix-cached
     seed: int | None = None
+    # cost-attribution identity (docs/OBSERVABILITY.md "Cost attribution"):
+    # tenant names the party billed for this request's capacity; sla_class
+    # selects which latency objectives it is measured against
+    tenant: str = "default"
+    sla_class: str = "interactive"
     request_id: str = field(
         default_factory=lambda: "cmpl-" + uuid.uuid4().hex[:24])
     # not wire fields: the frontend attaches the sampled TraceContext here
@@ -96,10 +116,26 @@ class CompletionRequest:
         if self.seed is not None:
             _require(int(self.seed) >= 0, "seed must be >= 0")
             self.seed = int(self.seed)
-        self.priority = int(self.priority)
+        try:
+            prio = int(self.priority)
+        except (TypeError, ValueError):
+            raise ProtocolError("priority must be an integer") from None
+        _require(prio == self.priority,  # reject 1.5 — no silent truncation
+                 "priority must be an integer")
+        self.priority = prio
+        _require(PRIORITY_MIN <= self.priority <= PRIORITY_MAX,
+                 f"priority must be in [{PRIORITY_MIN}, {PRIORITY_MAX}], "
+                 f"got {self.priority}")
         self.stream = bool(self.stream)
         _require(isinstance(self.request_id, str) and len(self.request_id) > 0,
                  "request_id must be a non-empty string")
+        _require(isinstance(self.tenant, str)
+                 and 0 < len(self.tenant) <= TENANT_MAX_LEN,
+                 f"tenant must be a non-empty string of at most "
+                 f"{TENANT_MAX_LEN} chars")
+        _require(self.sla_class in SLA_CLASSES,
+                 f"sla_class must be one of {list(SLA_CLASSES)}, "
+                 f"got {self.sla_class!r}")
 
     @property
     def total_tokens(self) -> int:
@@ -113,7 +149,7 @@ class CompletionRequest:
         known = {
             "prompt", "max_tokens", "temperature", "top_k", "top_p",
             "stream", "eos_token_id", "deadline_s", "priority", "request_id",
-            "seed",
+            "seed", "tenant", "sla_class",
         }
         unknown = set(body) - known
         _require(not unknown, f"unknown fields: {sorted(unknown)}")
@@ -139,6 +175,10 @@ class CompletionResponse:
     created: float = field(default_factory=time.time)
     # trace id echoed to the client when the request was sampled
     trace_id: str | None = None
+    # cost-attribution identity echoed back so clients can reconcile their
+    # own accounting against the server-side ledger
+    tenant: str | None = None
+    sla_class: str | None = None
 
     def to_json(self) -> dict:
         out = {
@@ -158,6 +198,9 @@ class CompletionResponse:
         }
         if self.trace_id:
             out["trace_id"] = self.trace_id
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+            out["sla_class"] = self.sla_class
         return out
 
 

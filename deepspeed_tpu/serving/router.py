@@ -115,6 +115,7 @@ def plan_placement(
     stats: list[ReplicaStats], total_tokens: int, cfg: RouterConfig,
     cached_tokens: list[int] | None = None,
     roles: tuple = ("unified", "decode"),
+    tenant_over_share: float = 0.0,
 ) -> tuple[int | None, str]:
     """Pure admission/placement decision over a stats snapshot.
 
@@ -131,6 +132,15 @@ def plan_placement(
     placement NOR failover resubmission can land a decode-bearing request
     on it (the never-fail-over-to-prefill invariant — resubmit() goes
     through this same function).
+
+    ``tenant_over_share`` is the cost meter's fair-share signal: how far
+    the requesting tenant's live-KV share exceeds its fair share (0.0 when
+    metering is off, the tenant is at/under fair share, or only one tenant
+    is active — those cases are byte-identical to the unmetered planner).
+    A positive value shrinks the queue bound this request may ride, so a
+    hog tenant hits backpressure (429 + retry-after) while the pool is
+    contended instead of filling every replica queue — soft steering, never
+    a hard quota.
 
     Returns ``(replica_index, verdict)`` where verdict is one of
     ``"admit"`` (free KV blocks now), ``"queue"`` (fits under the queue
@@ -164,17 +174,20 @@ def plan_placement(
             free = min(free, s.headroom_blocks - s.pending_blocks)
         return free
 
+    queue_bound = cfg.max_queue_tokens
+    if tenant_over_share > 0.0:
+        queue_bound = int(queue_bound / (1.0 + tenant_over_share))
     fits_now = [
         (i, s) for i, s in live
         if need(i, s) <= cap(s)
-        and load(i, s) <= cfg.max_queue_tokens
+        and load(i, s) <= queue_bound
     ]
     if fits_now:
         i, _ = min(fits_now,
                    key=lambda t: (t[1].outstanding_tokens, -cached(t[0])))
         return i, "admit"
     can_queue = [
-        (i, s) for i, s in live if load(i, s) <= cfg.max_queue_tokens
+        (i, s) for i, s in live if load(i, s) <= queue_bound
     ]
     if can_queue:
         i, _ = min(can_queue,
@@ -320,8 +333,17 @@ class ReplicaRouter:
             ]
             cached = [r.cached_prefix_tokens(req.prompt)
                       for r in replicas]
+            over = 0.0
+            cm = tel.costmeter
+            if cm is not None:
+                # fair-share steering: how far this tenant's live-KV share
+                # exceeds 1/active_tenants (exactly 0.0 single-tenant)
+                share, fair = cm.outstanding_share(
+                    getattr(req, "tenant", "default"))
+                over = max(0.0, share - fair) * cm.fairness_weight
             idx, verdict = plan_placement(masked, req.total_tokens, self.cfg,
-                                          cached_tokens=cached)
+                                          cached_tokens=cached,
+                                          tenant_over_share=over)
             if idx is None:
                 if verdict == "draining":
                     # distinguish "every replica is gone/draining" (503)
@@ -537,6 +559,15 @@ class ReplicaRouter:
                 "tuned_profile_loaded",
                 "1 when a persisted autotune profile was applied at startup",
             ).set(1.0 if self.tuned_profile else 0.0, kind="serving")
+        cm = tel.costmeter
+        if cm is not None:
+            for row in cm.ledger.rows():
+                if row["outstanding_blocks"] or row["kv_block_seconds"]:
+                    tel.gauge(
+                        "tenant_outstanding_blocks",
+                        "live KV blocks held per tenant (fair-share input)",
+                    ).set(row["outstanding_blocks"],
+                          tenant=cm.tenant_label(row["tenant"]))
         breaker_rank = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
         for r, s, h in zip(replicas, stats, health):
             tel.gauge(

@@ -22,6 +22,12 @@ block; ``deepspeed_tpu.initialize`` wires the engine emit points.
 """
 
 from deepspeed_tpu.telemetry.core import TELEMETRY, Telemetry  # noqa: F401
+from deepspeed_tpu.telemetry.costmeter import (  # noqa: F401
+    CostMeter,
+    OTHER_TENANT,
+    RequestCost,
+    TenantLedger,
+)
 from deepspeed_tpu.telemetry.devprof import (  # noqa: F401
     DeviceProfiler,
     capture_serving,
@@ -55,6 +61,7 @@ from deepspeed_tpu.telemetry.stepscope import StepScope  # noqa: F401
 from deepspeed_tpu.telemetry.slo import (  # noqa: F401
     SloMonitor,
     SloObjective,
+    default_class_objectives,
     default_objectives,
 )
 from deepspeed_tpu.telemetry.tracing import (  # noqa: F401

@@ -48,6 +48,7 @@ class Telemetry:
         # construction); only its ``enabled`` flag toggles with configure()
         self.tracer = Tracer(self.registry)
         self._slo = None
+        self._costmeter = None
         self._compile_watch = None
         self._memledger = None
         self._fleet = None
@@ -110,15 +111,42 @@ class Telemetry:
                     sample_rate=float(tracing.get("sample_rate", 1.0)),
                     ring_capacity=int(tracing.get("ring_capacity", 4096)),
                 )
+            cm = opts.get("costmeter") or {}
+            if cm is True:
+                cm = {"enabled": True}
             slo = opts.get("slo") or {}
             if slo is True:
                 slo = {"enabled": True}
             if slo.get("enabled"):
                 from deepspeed_tpu.telemetry.slo import (
                     SloMonitor,
+                    default_class_objectives,
                     default_objectives,
                 )
 
+                # per-SLA-class objectives: explicit per-class threshold
+                # dicts, bare True for the defaults, or implied by an
+                # enabled costmeter (class accounting is its whole point)
+                classes = slo.get("classes")
+                if classes is None and cm.get("enabled"):
+                    classes = True
+                class_objs = None
+                if classes is True:
+                    class_objs = default_class_objectives(
+                        window_s=float(slo.get("window_s", 300.0)),
+                        target=float(slo.get("target", 0.99)))
+                elif classes:
+                    class_objs = {
+                        cls: default_objectives(
+                            ttft_threshold_s=float(
+                                c.get("ttft_threshold_s", 0.5)),
+                            decode_threshold_s=float(
+                                c.get("decode_threshold_s", 0.05)),
+                            target=float(c.get("target",
+                                               slo.get("target", 0.99))),
+                            window_s=float(c.get("window_s",
+                                                 slo.get("window_s", 300.0))),
+                        ) for cls, c in classes.items()}
                 self._slo = SloMonitor(
                     default_objectives(
                         ttft_threshold_s=float(
@@ -131,8 +159,19 @@ class Telemetry:
                     self.registry,
                     burn_threshold=float(slo.get("burn_threshold", 1.0)),
                     replica=slo.get("replica"),
+                    class_objectives=class_objs,
                 )
                 self._slo.refresh_gauges()
+            if cm.get("enabled"):
+                from deepspeed_tpu.telemetry.costmeter import CostMeter
+
+                self._costmeter = CostMeter(
+                    self.registry,
+                    max_tenants=int(cm.get("max_tenants", 32)),
+                    window_s=float(cm.get("window_s", 300.0)),
+                    top_k=int(cm.get("top_k", 10)),
+                    fairness_weight=float(cm.get("fairness_weight", 1.0)),
+                )
             if opts.get("compile_metrics", True):
                 from deepspeed_tpu.telemetry.compile_watch import CompileWatch
 
@@ -171,6 +210,7 @@ class Telemetry:
                                     if self._prometheus else None),
                    tracing=self.tracer.enabled,
                    slo=self._slo is not None,
+                   costmeter=self._costmeter is not None,
                    memledger=self._memledger is not None,
                    fleet=(self._fleet.worker if self._fleet else None))
         return self
@@ -309,12 +349,22 @@ class Telemetry:
         """The configured :class:`SloMonitor`, or None."""
         return self._slo
 
-    def observe_slo(self, objective: str, value_s: float) -> None:
+    def observe_slo(self, objective: str, value_s: float,
+                    sla_class: str | None = None) -> None:
         """Record a request latency against an SLO objective (no-op when
-        no monitor is configured)."""
+        no monitor is configured). ``sla_class`` additionally scores the
+        sample against that class's own objectives when configured."""
         slo = self._slo
         if slo is not None:
-            slo.record(objective, value_s)
+            slo.record(objective, value_s, sla_class=sla_class)
+
+    # ------------------------------------------------------------- costmeter
+    @property
+    def costmeter(self):
+        """The configured :class:`CostMeter`, or None (the engine guards
+        every metering seam on this one attribute read — off means zero
+        costmeter code runs)."""
+        return self._costmeter
 
     # ------------------------------------------------------------- compile
     @property
@@ -395,6 +445,7 @@ class Telemetry:
         self._since_flush = 0
         self.tracer.reset()
         self._slo = None
+        self._costmeter = None
         if self._compile_watch is not None:
             try:
                 self._compile_watch.uninstall()

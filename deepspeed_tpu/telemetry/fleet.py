@@ -394,12 +394,35 @@ class FleetAggregator:
             lb = s["labels"]
             slo.setdefault(lb.get("worker", "?"), {})[
                 lb.get("objective", "?")] = s["value"]
+        breaching_classes: set[tuple[str, str]] = set()
         for s in self._series(merged, "slo_breaching"):
             if s["value"]:
                 breaching_workers.add(s["labels"].get("worker", "?"))
+                cls = s["labels"].get("sla_class")
+                if cls:
+                    breaching_classes.add(
+                        (cls, s["labels"].get("objective", "?")))
         if breaching_workers:
             reasons.append(
                 "slo breaching on: " + ",".join(sorted(breaching_workers)))
+        if breaching_classes:
+            reasons.append("class objectives breaching: " + ",".join(
+                sorted(f"{c}/{o}" for c, o in breaching_classes)))
+
+        # --- per-tenant cost rollup: request_cost_* counter rows merge
+        # across workers (tenant cardinality is bounded upstream by each
+        # worker's CostMeter label cap, so this stays small)
+        tenants: dict[str, dict] = {}
+        for name, key in (("request_cost_kv_block_seconds_total",
+                           "kv_block_seconds"),
+                          ("request_cost_decode_tokens_total",
+                           "decode_tokens"),
+                          ("request_cost_prefill_tokens_total",
+                           "prefill_tokens")):
+            for s in self._series(merged, name):
+                t = s["labels"].get("tenant", "?")
+                row = tenants.setdefault(t, {})
+                row[key] = row.get(key, 0.0) + s["value"]
 
         # --- memory census drift
         census = {}
@@ -474,6 +497,10 @@ class FleetAggregator:
             "workers": workers,
             "roles": roles,
             "slo_burn": slo,
+            "breaching_classes": [
+                {"sla_class": c, "objective": o}
+                for c, o in sorted(breaching_classes)],
+            "tenants": tenants,
             "census": census,
             "breakers": breakers,
             "kv_tiers": tiers,

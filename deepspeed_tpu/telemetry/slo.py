@@ -63,6 +63,22 @@ def default_objectives(ttft_threshold_s: float = 0.5,
     ]
 
 
+def default_class_objectives(window_s: float = 300.0,
+                             target: float = 0.99) -> dict:
+    """Per-SLA-class objective sets (docs/SERVING.md request ``sla_class``):
+    interactive requests are held to the tight thresholds, batch to relaxed
+    ones — each class burns its own error budget so a batch backlog cannot
+    mask an interactive-tail regression (or vice versa)."""
+    return {
+        "interactive": default_objectives(
+            ttft_threshold_s=0.5, decode_threshold_s=0.05,
+            target=target, window_s=window_s),
+        "batch": default_objectives(
+            ttft_threshold_s=5.0, decode_threshold_s=0.25,
+            target=target, window_s=window_s),
+    }
+
+
 class SloMonitor:
     """Records (timestamp, good?) samples per objective and publishes
     burn-rate gauges into the metrics registry at record and scrape time."""
@@ -70,9 +86,20 @@ class SloMonitor:
     MIN_SAMPLES = MIN_SAMPLES
 
     def __init__(self, objectives, registry, burn_threshold: float = 1.0,
-                 replica: str | None = None):
+                 replica: str | None = None, class_objectives=None):
         self._objectives = {o.name: o for o in objectives}
         self._samples = {o.name: deque() for o in objectives}
+        # per-SLA-class objective sets: {sla_class: [SloObjective, ...]}.
+        # Class samples live in their own windows keyed (class, name) and
+        # publish {objective=,sla_class=} series; the base (classless)
+        # series keeps seeing every record so existing dashboards hold.
+        self._class_objectives = {
+            cls: {o.name: o for o in objs}
+            for cls, objs in (class_objectives or {}).items()}
+        self._class_samples = {
+            (cls, name): deque()
+            for cls, objs in self._class_objectives.items()
+            for name in objs}
         self._registry = registry
         self.burn_threshold = float(burn_threshold)
         # distinct replicas' monitors sharing one process (and therefore
@@ -86,34 +113,56 @@ class SloMonitor:
         return dict(self._objectives)
 
     # ------------------------------------------------------------- recording
-    def record(self, name: str, value_s: float, now: float | None = None):
+    def record(self, name: str, value_s: float, now: float | None = None,
+               sla_class: str | None = None):
         """Record one request latency against objective ``name`` (unknown
-        names are ignored so callers need no registration handshake)."""
-        obj = self._objectives.get(name)
-        if obj is None:
-            return
+        names are ignored so callers need no registration handshake).
+        ``sla_class`` additionally scores the sample against that class's
+        own threshold/window when class objectives are configured."""
         t = time.monotonic() if now is None else now
-        with self._lock:
-            window = self._samples[name]
-            window.append((t, value_s <= obj.threshold_s))
-            self._prune_locked(name, t)
-        self._publish(name, t)
+        obj = self._objectives.get(name)
+        if obj is not None:
+            with self._lock:
+                window = self._samples[name]
+                window.append((t, value_s <= obj.threshold_s))
+                self._prune_locked(name, t)
+            self._publish(name, t)
+        if sla_class is not None:
+            cobj = self._class_objectives.get(sla_class, {}).get(name)
+            if cobj is not None:
+                with self._lock:
+                    window = self._class_samples[(sla_class, name)]
+                    window.append((t, value_s <= cobj.threshold_s))
+                    self._prune_locked(name, t, sla_class)
+                self._publish(name, t, sla_class)
 
-    def _prune_locked(self, name: str, now: float) -> None:
-        window = self._samples[name]
-        horizon = now - self._objectives[name].window_s
+    def _prune_locked(self, name: str, now: float,
+                      sla_class: str | None = None) -> None:
+        if sla_class is None:
+            window = self._samples[name]
+            horizon = now - self._objectives[name].window_s
+        else:
+            window = self._class_samples[(sla_class, name)]
+            horizon = now - self._class_objectives[sla_class][name].window_s
         while window and window[0][0] < horizon:
             window.popleft()
 
     # --------------------------------------------------------------- queries
-    def stats(self, name: str, now: float | None = None) -> dict:
+    def stats(self, name: str, now: float | None = None,
+              sla_class: str | None = None) -> dict:
         """``{count, good_fraction, burn_rate, breaching}`` for one
         objective over its current window."""
-        obj = self._objectives[name]
+        if sla_class is None:
+            obj = self._objectives[name]
+        else:
+            obj = self._class_objectives[sla_class][name]
         t = time.monotonic() if now is None else now
         with self._lock:
-            self._prune_locked(name, t)
-            window = list(self._samples[name])
+            self._prune_locked(name, t, sla_class)
+            if sla_class is None:
+                window = list(self._samples[name])
+            else:
+                window = list(self._class_samples[(sla_class, name)])
         count = len(window)
         good = sum(1 for _, ok in window if ok)
         good_fraction = good / count if count else 1.0
@@ -131,19 +180,38 @@ class SloMonitor:
         }
 
     def breaching(self) -> bool:
-        return any(self.stats(n)["breaching"] for n in self._objectives)
+        if any(self.stats(n)["breaching"] for n in self._objectives):
+            return True
+        return any(self.stats(n, sla_class=cls)["breaching"]
+                   for cls, objs in self._class_objectives.items()
+                   for n in objs)
+
+    def breaching_classes(self) -> list[tuple[str, str]]:
+        """``(sla_class, objective)`` pairs currently out of budget."""
+        return [(cls, n)
+                for cls, objs in self._class_objectives.items()
+                for n in objs
+                if self.stats(n, sla_class=cls)["breaching"]]
 
     def health(self) -> dict:
         """Per-objective summary embedded in the ``/healthz`` body."""
-        return {n: self.stats(n) for n in self._objectives}
+        out = {n: self.stats(n) for n in self._objectives}
+        if self._class_objectives:
+            out["by_class"] = {
+                cls: {n: self.stats(n, sla_class=cls) for n in objs}
+                for cls, objs in self._class_objectives.items()}
+        return out
 
     # --------------------------------------------------------------- gauges
-    def _publish(self, name: str, now: float | None = None) -> None:
+    def _publish(self, name: str, now: float | None = None,
+                 sla_class: str | None = None) -> None:
         # the clock must follow the caller's (record passes its timestamp
         # through; a wall-clock prune here would evict replayed samples)
-        s = self.stats(name, now)
+        s = self.stats(name, now, sla_class)
         reg = self._registry
         labels = {"objective": name}
+        if sla_class is not None:
+            labels["sla_class"] = sla_class
         if self.replica is not None:
             labels["replica"] = self.replica
         reg.gauge("slo_burn_rate",
@@ -164,3 +232,6 @@ class SloMonitor:
         visibly without waiting for the next request)."""
         for name in self._objectives:
             self._publish(name)
+        for cls, objs in self._class_objectives.items():
+            for name in objs:
+                self._publish(name, sla_class=cls)

@@ -80,6 +80,35 @@ class TestProtocol:
         req = CompletionRequest.from_json({"prompt": [3], "seed": 7})
         assert req.seed == 7
 
+    def test_priority_bounds_validated(self):
+        from deepspeed_tpu.serving.protocol import PRIORITY_MAX, PRIORITY_MIN
+
+        # the exact boundaries are accepted verbatim
+        for edge in (PRIORITY_MIN, PRIORITY_MAX, 0):
+            req = CompletionRequest.from_json(
+                {"prompt": [1], "priority": edge})
+            assert req.priority == edge
+        # anything outside (or non-integer) is a protocol error, never a
+        # silent clamp — the scheduler must see exactly what the client sent
+        for bad in (PRIORITY_MIN - 1, PRIORITY_MAX + 1, 10**9, "high", 1.5):
+            with pytest.raises(ProtocolError):
+                CompletionRequest.from_json({"prompt": [1], "priority": bad})
+
+    def test_tenant_and_sla_class_validated(self):
+        req = CompletionRequest.from_json(
+            {"prompt": [1], "tenant": "acme", "sla_class": "batch"})
+        assert req.tenant == "acme" and req.sla_class == "batch"
+        # defaults when absent from the wire
+        req = CompletionRequest.from_json({"prompt": [1]})
+        assert req.tenant == "default" and req.sla_class == "interactive"
+        for body in (
+            {"prompt": [1], "tenant": ""},
+            {"prompt": [1], "tenant": "x" * 65},
+            {"prompt": [1], "sla_class": "platinum"},
+        ):
+            with pytest.raises(ProtocolError):
+                CompletionRequest.from_json(body)
+
     def test_sse_round_trip(self):
         frames = [{"id": "r1", "token": 17, "index": 0},
                   {"id": "r1", "token": 3, "index": 1},
@@ -289,6 +318,32 @@ class TestEndToEnd:
         conn, resp = _post(frontend, {"prompt": []})
         assert resp.status == 400
         assert "error" in json.loads(resp.read())
+        conn.close()
+
+    def test_out_of_range_priority_400(self, server):
+        frontend, _, _, _ = server
+        for bad in (1000, -1000, "urgent"):
+            conn, resp = _post(frontend, {"prompt": _prompt(4),
+                                          "priority": bad})
+            assert resp.status == 400
+            err = json.loads(resp.read())["error"]
+            assert "priority" in err["message"]
+            conn.close()
+
+    def test_tenant_identity_echoed(self, server):
+        frontend, _, _, _ = server
+        conn, resp = _post(frontend, {"prompt": _prompt(5), "max_tokens": 2,
+                                      "tenant": "acme", "sla_class": "batch"})
+        assert resp.status == 200
+        body = json.loads(resp.read())
+        conn.close()
+        assert body["tenant"] == "acme"
+        assert body["sla_class"] == "batch"
+        # invalid identity is a structured 400, not a silent default
+        conn, resp = _post(frontend, {"prompt": _prompt(4),
+                                      "sla_class": "platinum"})
+        assert resp.status == 400
+        assert "sla_class" in json.loads(resp.read())["error"]["message"]
         conn.close()
 
     def test_overload_429_retry_after(self):
